@@ -1,0 +1,31 @@
+"""quiver-tpu: TPU-native graph sampling + feature collection for GNN training.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of
+ed-aisys/torch-quiver (see SURVEY.md): GPU-class k-hop neighbor sampling and
+tiered feature caching for PyG-style mini-batch GNN training, redesigned for
+TPU — static shapes, single-controller SPMD over a ``jax.Mesh``, ICI
+collectives instead of NVLink peer access, and host-offload staging instead
+of UVA zero-copy.
+
+Top-level exports mirror the reference package surface
+(torch-quiver srcs/python/quiver/__init__.py:1-10).
+"""
+
+from .core.config import CachePolicy, SampleMode, parse_size_bytes
+from .core.topology import CSRTopo, DeviceTopology
+from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
+from .utils.reorder import reorder_by_degree
+
+__all__ = [
+    "CSRTopo",
+    "DeviceTopology",
+    "GraphSageSampler",
+    "Adj",
+    "SampleOutput",
+    "CachePolicy",
+    "SampleMode",
+    "parse_size_bytes",
+    "reorder_by_degree",
+]
+
+__version__ = "0.1.0"

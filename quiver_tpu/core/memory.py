@@ -1,0 +1,28 @@
+"""Memory-placement helpers shared by topology and feature tiers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_pinned_host"]
+
+
+def to_pinned_host(x: np.ndarray) -> tuple[jax.Array, bool]:
+    """Place an array in pinned host memory if the platform supports it.
+
+    Returns (array, is_host). Falls back to default device placement with
+    is_host=False on platforms without a pinned_host memory space — callers
+    branch on the flag to pick direct vs staged gathers.
+    """
+    dev = jax.devices()[0]
+    try:
+        s = jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host")
+        arr = jax.device_put(np.asarray(x), s)
+        if getattr(arr.sharding, "memory_kind", None) == "pinned_host":
+            return arr, True
+    except Exception:
+        pass
+    return jnp.asarray(x), False

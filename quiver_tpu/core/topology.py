@@ -1,0 +1,192 @@
+"""Graph topology containers.
+
+``CSRTopo`` is the host-side CSR graph container, capability-parity with the
+reference's ``quiver.CSRTopo`` (torch-quiver utils.py:117-210): build from COO
+``edge_index`` or from ``indptr``/``indices``, expose ``degree``/``eid``/
+``feature_order``. Construction is pure numpy (no scipy needed — a stable
+argsort plus bincount replaces the reference's ``scipy.sparse.csr_matrix``
+round-trip, utils.py:107-114).
+
+``DeviceTopology`` is the device-side view: a pytree of jnp arrays placed in
+HBM (reference "GPU" mode) or pinned host memory (the TPU stand-in for the
+reference's UVA zero-copy registration, quiver_sample.cu:400-408).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .config import SampleMode
+from .memory import to_pinned_host
+
+__all__ = ["CSRTopo", "DeviceTopology"]
+
+
+def _as_numpy(x) -> np.ndarray:
+    """Coerce array-likes (numpy, lists, torch CPU tensors) to numpy."""
+    if hasattr(x, "detach"):  # torch tensor without importing torch
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _index_dtype(max_value: int) -> np.dtype:
+    return np.dtype(np.int32) if max_value <= np.iinfo(np.int32).max else np.dtype(np.int64)
+
+
+class CSRTopo:
+    """CSR graph topology with degree and feature-order bookkeeping.
+
+    Parameters mirror the reference: either ``edge_index`` (2, E) COO, or
+    ``indptr`` + ``indices`` directly. ``eid`` maps CSR edge slots back to
+    the original COO edge positions (identity when built from indptr/indices).
+    """
+
+    def __init__(self, edge_index=None, indptr=None, indices=None, eid=None):
+        if edge_index is not None:
+            if indptr is not None or indices is not None:
+                raise ValueError("pass either edge_index or indptr/indices, not both")
+            edge_index = _as_numpy(edge_index)
+            if edge_index.ndim != 2 or edge_index.shape[0] != 2:
+                raise ValueError(f"edge_index must be (2, E), got {edge_index.shape}")
+            row, col = edge_index[0], edge_index[1]
+            node_count = int(max(row.max(initial=-1), col.max(initial=-1)) + 1)
+            order = np.argsort(row, kind="stable")
+            counts = np.bincount(row, minlength=node_count)
+            indptr = np.zeros(node_count + 1, dtype=np.int64)
+            np.cumsum(counts, out=indptr[1:])
+            indices = np.ascontiguousarray(col[order])
+            eid = order
+        elif indptr is not None and indices is not None:
+            indptr = _as_numpy(indptr).astype(np.int64, copy=False)
+            indices = _as_numpy(indices)
+            if eid is not None:
+                eid = _as_numpy(eid)
+            # user-supplied CSR: validate, because XLA's clamping gathers
+            # would otherwise turn inconsistencies into silently wrong samples
+            if indptr.ndim != 1 or indptr.shape[0] < 1 or indptr[0] != 0:
+                raise ValueError("indptr must be 1-D and start at 0")
+            if np.any(np.diff(indptr) < 0):
+                raise ValueError("indptr must be non-decreasing")
+            if int(indptr[-1]) != indices.shape[0]:
+                raise ValueError(
+                    f"indptr[-1]={int(indptr[-1])} != len(indices)={indices.shape[0]}"
+                )
+        else:
+            raise ValueError("need edge_index or indptr+indices")
+
+        node_count = int(indptr.shape[0] - 1)
+        if indices.size and int(indices.max()) >= node_count:
+            raise ValueError(
+                f"indices reference node {int(indices.max())} but indptr only "
+                f"defines {node_count} nodes"
+            )
+        edge_count = int(indptr[-1])
+        self._indptr = indptr.astype(_index_dtype(edge_count), copy=False)
+        self._indices = indices.astype(_index_dtype(max(node_count - 1, 0)), copy=False)
+        self._eid = None if eid is None else eid.astype(_index_dtype(max(edge_count - 1, 0)), copy=False)
+        self._feature_order = None  # set by Feature's degree reorder
+
+    # -- properties (parity with reference utils.py:150-210) ---------------
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self._indptr
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+    @property
+    def eid(self) -> np.ndarray | None:
+        return self._eid
+
+    @property
+    def feature_order(self) -> np.ndarray | None:
+        """Old-node-id -> reordered-feature-row map, shared with Feature."""
+        return self._feature_order
+
+    @feature_order.setter
+    def feature_order(self, order):
+        order = _as_numpy(order)
+        if order.shape != (self.node_count,):
+            raise ValueError(
+                f"feature_order must have shape ({self.node_count},), got {order.shape}"
+            )
+        self._feature_order = order
+
+    @property
+    def degree(self) -> np.ndarray:
+        return np.diff(self._indptr)
+
+    @property
+    def max_degree(self) -> int:
+        return int(self.degree.max(initial=0))
+
+    @property
+    def node_count(self) -> int:
+        return int(self._indptr.shape[0] - 1)
+
+    @property
+    def edge_count(self) -> int:
+        return int(self._indptr[-1])
+
+    def __repr__(self):
+        return f"CSRTopo(nodes={self.node_count}, edges={self.edge_count})"
+
+    # -- device placement ---------------------------------------------------
+
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM, with_eid: bool = False) -> "DeviceTopology":
+        """Place the topology for sampling.
+
+        HBM mode puts everything in device memory. HOST mode keeps the large
+        ``indices`` (and ``eid``) arrays in pinned host memory where supported
+        — on platforms without a pinned_host memory space it degrades to HBM
+        with a warning-free fallback (CPU tests take this path).
+        """
+        mode = SampleMode.parse(mode)
+        indptr = jnp.asarray(self._indptr)
+        eid = jnp.asarray(self._eid) if (with_eid and self._eid is not None) else None
+        host = False
+        if mode == SampleMode.HOST:
+            indices, host = to_pinned_host(self._indices)
+            if eid is not None and host:
+                eid, _ = to_pinned_host(self._eid)
+        else:
+            indices = jnp.asarray(self._indices)
+        return DeviceTopology(indptr=indptr, indices=indices, eid=eid, host_indices=host)
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceTopology:
+    """Device-resident CSR arrays, usable inside jit as a pytree.
+
+    ``host_indices`` is static metadata: True when ``indices``/``eid`` live in
+    pinned host memory (HOST mode) so gathers must stage through host compute.
+    """
+
+    def __init__(self, indptr, indices, eid=None, host_indices: bool = False):
+        self.indptr = indptr
+        self.indices = indices
+        self.eid = eid
+        self.host_indices = host_indices
+
+    @property
+    def node_count(self) -> int:
+        return self.indptr.shape[0] - 1
+
+    @property
+    def edge_count(self) -> int:
+        return self.indices.shape[0]
+
+    def tree_flatten(self):
+        if self.eid is None:
+            return (self.indptr, self.indices), ("no_eid", self.host_indices)
+        return (self.indptr, self.indices, self.eid), ("eid", self.host_indices)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        eid = children[2] if aux[0] == "eid" else None
+        return cls(children[0], children[1], eid, host_indices=aux[1])

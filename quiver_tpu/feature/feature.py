@@ -1,0 +1,192 @@
+"""Tiered feature store: HBM hot tier + host-memory cold tier.
+
+Capability parity with the reference's ``quiver.Feature``
+(torch-quiver feature.py:29-308): byte-budget hot/cold split, optional
+degree-based reorder so high-degree (hot) nodes fill the cache
+(feature.py:112-116), ``feature_order`` id translation on lookup
+(feature.py:184-195), and two placement policies. TPU redesign:
+
+* ``device_replicate`` → hot rows replicated in each device's HBM (same
+  policy, feature.py:120-124).
+* ``p2p_clique_replicate`` → hot rows *sharded over the mesh* with gathers
+  riding ICI collectives (see feature/shard.py) — ICI plays NVLink's role
+  (feature.py:126-166, quiver_feature.cu gather over ``dev_ptrs``).
+* UVA zero-copy cold tier → pinned-host-resident cold shard with staged
+  host-compute gathers (feature.py:169-182; TPU kernels cannot dereference
+  host pointers, SURVEY §2.3 mapping (3)).
+
+No IPC machinery (share_ipc/lazy rebuild, feature.py:234-308): one process
+controls the mesh. The methods exist as no-op parity shims.
+
+Cold-lane trick: every lookup gathers both tiers at full batch width (static
+shapes), but lanes belonging to the other tier are pointed at row 0, so the
+host-side cost collapses to the true cold-miss count's bandwidth (repeated
+row 0 stays in cache) rather than the batch width.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import CachePolicy, parse_size_bytes
+from ..core.memory import to_pinned_host
+from ..core.topology import CSRTopo
+from ..ops.sample import staged_gather
+from ..utils.reorder import reorder_by_degree
+
+__all__ = ["Feature"]
+
+
+@jax.tree_util.register_pytree_node_class
+class Feature:
+    """Tiered node-feature table with jit-compatible lookup.
+
+    Args mirror the reference's constructor (feature.py:29-44):
+      device_cache_size: hot-tier byte budget ("0.9M", "3GB", int bytes).
+      cache_policy: "device_replicate" | "p2p_clique_replicate"/"mesh_shard".
+      csr_topo: enables degree-based hot ordering; sets csr_topo.feature_order.
+    """
+
+    def __init__(
+        self,
+        rank: int = 0,
+        device_list=None,
+        device_cache_size: int | str = 0,
+        cache_policy: str | CachePolicy = CachePolicy.DEVICE_REPLICATE,
+        csr_topo: CSRTopo | None = None,
+        hot_shuffle_seed: int = 0,
+    ):
+        self.rank = rank
+        self.device_list = device_list or [0]
+        self.cache_budget = parse_size_bytes(device_cache_size)
+        self.cache_policy = CachePolicy.parse(cache_policy)
+        self.csr_topo = csr_topo
+        self.hot_shuffle_seed = hot_shuffle_seed
+        # populated by from_cpu_tensor
+        self.hot = None
+        self.cold = None
+        self.feature_order = None
+        self.hot_rows = 0
+        self.shape = None
+        self.dtype = None
+        self._cold_is_host = False
+
+    # -- construction -------------------------------------------------------
+
+    def from_cpu_tensor(self, tensor) -> "Feature":
+        """Split, (optionally) reorder, and place the feature table."""
+        if self.cache_policy is CachePolicy.MESH_SHARD:
+            raise NotImplementedError(
+                "mesh_shard placement lives in quiver_tpu.feature.shard."
+                "ShardedFeature; plain Feature supports device_replicate only"
+            )
+        tensor = np.asarray(tensor)
+        n, f = tensor.shape
+        row_bytes = f * tensor.dtype.itemsize
+        hot_rows = min(n, self.cache_budget // row_bytes)
+
+        if self.csr_topo is not None and hot_rows < n:
+            hot_ratio = hot_rows / n
+            tensor, order = reorder_by_degree(
+                tensor, self.csr_topo.degree, hot_ratio, seed=self.hot_shuffle_seed
+            )
+            self.csr_topo.feature_order = order
+            self.feature_order = jnp.asarray(order)
+
+        self.shape = (n, f)
+        self.dtype = tensor.dtype
+        self.hot_rows = int(hot_rows)
+        if hot_rows > 0:
+            self.hot = jnp.asarray(tensor[:hot_rows])
+        if hot_rows < n:
+            self.cold, self._cold_is_host = to_pinned_host(tensor[hot_rows:])
+        return self
+
+    @classmethod
+    def from_numpy(cls, tensor, **kwargs) -> "Feature":
+        return cls(**kwargs).from_cpu_tensor(tensor)
+
+    # -- lookup -------------------------------------------------------------
+
+    def __getitem__(self, n_id):
+        """Gather rows for (possibly padded, -1 sentinel) node ids.
+
+        Jit-composable; invalid lanes return zero rows.
+        """
+        n_id = jnp.asarray(n_id)
+        valid = n_id >= 0
+        ids = jnp.where(valid, n_id, 0)
+        if self.feature_order is not None:
+            ids = self.feature_order[ids]
+
+        if self.cold is None:
+            out = self.hot[ids]
+        elif self.hot is None:
+            out = staged_gather(self.cold, ids, self._cold_is_host)
+        else:
+            is_hot = ids < self.hot_rows
+            hot_idx = jnp.where(is_hot, ids, 0)
+            cold_idx = jnp.where(is_hot, 0, ids - self.hot_rows)
+            hot_part = self.hot[hot_idx]
+            cold_part = staged_gather(self.cold, cold_idx, self._cold_is_host)
+            out = jnp.where(is_hot[:, None], hot_part, cold_part)
+        return jnp.where(valid[:, None], out, 0)
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    @property
+    def cache_ratio(self) -> float:
+        return self.hot_rows / self.shape[0] if self.shape else 0.0
+
+    # -- pytree (so Feature can be closed over / passed into jit) ----------
+
+    def tree_flatten(self):
+        children = (self.hot, self.cold, self.feature_order)
+        aux = (
+            self.rank,
+            tuple(self.device_list),
+            self.cache_budget,
+            self.cache_policy,
+            self.hot_rows,
+            self.shape,
+            self.dtype,
+            self._cold_is_host,
+            self.hot_shuffle_seed,
+        )
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.hot, obj.cold, obj.feature_order = children
+        (
+            obj.rank,
+            device_list,
+            obj.cache_budget,
+            obj.cache_policy,
+            obj.hot_rows,
+            obj.shape,
+            obj.dtype,
+            obj._cold_is_host,
+            obj.hot_shuffle_seed,
+        ) = aux
+        obj.device_list = list(device_list)
+        obj.csr_topo = None
+        return obj
+
+    # -- reference API shims (IPC is a no-op under single-controller SPMD) --
+
+    def share_ipc(self):
+        return self
+
+    @classmethod
+    def new_from_ipc_handle(cls, rank, handle):
+        return handle
+
+    @classmethod
+    def lazy_from_ipc_handle(cls, handle):
+        return handle

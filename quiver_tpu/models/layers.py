@@ -1,0 +1,53 @@
+"""Message-passing primitives over padded Adj blocks.
+
+The reference delegates all modeling to PyG (SAGEConv etc. in example
+scripts, examples/pyg/reddit_quiver.py:42-65); quiver-tpu ships its own
+TPU-native GNN layers because PyG/torch are out of the build. Edges arrive
+as padded ``edge_index`` (2, E) with -1 sentinels (source = frontier-local
+id, target = seed-local id); aggregation uses ``jax.ops.segment_sum`` with an
+overflow bucket for invalid lanes — scatter-free, shape-static, MXU-friendly
+(all matmuls are dense (N, F) x (F, F')).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["segment_mean_aggregate", "segment_softmax", "gather_src"]
+
+
+def gather_src(x, src):
+    """Gather per-edge source features; invalid lanes (src == -1) give zeros."""
+    valid = src >= 0
+    h = x[jnp.clip(src, 0)]
+    return jnp.where(valid[:, None], h, 0.0), valid
+
+
+def segment_mean_aggregate(messages, dst, valid, num_dst: int):
+    """Mean-aggregate edge messages into target nodes.
+
+    Invalid lanes are routed to an overflow segment (index num_dst) and
+    sliced off — the padded-shape analogue of skipping masked edges.
+    """
+    seg = jnp.where(valid, dst, num_dst)
+    total = jax.ops.segment_sum(messages, seg, num_segments=num_dst + 1)[:num_dst]
+    cnt = jax.ops.segment_sum(valid.astype(messages.dtype), seg, num_segments=num_dst + 1)[:num_dst]
+    return total / jnp.maximum(cnt, 1.0)[:, None]
+
+
+def segment_softmax(logits, seg, valid, num_seg: int):
+    """Numerically-stable softmax over edges grouped by target segment.
+
+    Exercises the same pattern a GAT needs (BASELINE.json config 4:
+    "attention aggregation, exercises segment-softmax").
+    """
+    seg_safe = jnp.where(valid, seg, num_seg)
+    neg = jnp.finfo(logits.dtype).min
+    masked = jnp.where(valid, logits, neg)
+    seg_max = jax.ops.segment_max(masked, seg_safe, num_segments=num_seg + 1)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = jnp.where(valid, logits - seg_max[seg_safe], neg)
+    expv = jnp.where(valid, jnp.exp(shifted), 0.0)
+    denom = jax.ops.segment_sum(expv, seg_safe, num_segments=num_seg + 1)
+    return expv / jnp.maximum(denom[seg_safe], jnp.finfo(logits.dtype).tiny)

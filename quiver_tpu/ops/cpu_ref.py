@@ -1,0 +1,87 @@
+"""Numpy reference sampler — the correctness oracle and CPU fallback.
+
+Capability parity with the reference's CPU tier (torch-quiver quiver.cpp:10-114
+``CPUQuiver`` over quiver.cpu.hpp:27-73): serial per-seed reservoir sampling
+(``std::sample`` equivalent via numpy choice without replacement) plus a
+hash-map reindex (``reindex_group``, quiver.cpp:39-84). Every JAX/Pallas
+kernel is differentially tested against this module, mirroring how the
+reference's CPU sampler anchors its CI (SURVEY §4).
+
+Outputs use the same padded (S, K) / -1-sentinel contract as the device ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sample_layer_ref", "reindex_layer_ref", "multilayer_ref"]
+
+
+def sample_layer_ref(indptr, indices, seeds, k, rng=None):
+    """Exact without-replacement uniform sampling, padded to (S, k)."""
+    rng = rng or np.random.default_rng(0)
+    S = len(seeds)
+    out = np.full((S, k), -1, dtype=np.int64)
+    counts = np.zeros(S, dtype=np.int64)
+    for r, s in enumerate(seeds):
+        if s < 0:
+            continue
+        lo, hi = int(indptr[s]), int(indptr[s + 1])
+        deg = hi - lo
+        if deg == 0:
+            continue
+        if deg <= k:
+            out[r, :deg] = indices[lo:hi]
+            counts[r] = deg
+        else:
+            pick = rng.choice(deg, size=k, replace=False)
+            out[r, :k] = indices[lo + pick]
+            counts[r] = k
+    return out, counts
+
+
+def reindex_layer_ref(seeds, neighbors):
+    """First-occurrence-order unique of seeds then neighbors (hash-map style).
+
+    Returns (frontier list, col_local (S,K) with -1 for invalid).
+    """
+    table: dict[int, int] = {}
+    frontier: list[int] = []
+
+    def lookup(v: int) -> int:
+        if v not in table:
+            table[v] = len(frontier)
+            frontier.append(v)
+        return table[v]
+
+    for s in seeds:
+        if s >= 0:
+            lookup(int(s))
+    col = np.full(neighbors.shape, -1, dtype=np.int64)
+    for r in range(neighbors.shape[0]):
+        for c in range(neighbors.shape[1]):
+            v = int(neighbors[r, c])
+            if v >= 0:
+                col[r, c] = lookup(v)
+    return np.asarray(frontier, dtype=np.int64), col
+
+
+def multilayer_ref(indptr, indices, seeds, sizes, rng=None):
+    """Multi-hop sample, returning per-layer (frontier, edge_index) innermost
+    first — the un-reversed order; callers reverse for PyG parity."""
+    rng = rng or np.random.default_rng(0)
+    layers = []
+    cur = np.asarray(seeds)
+    for k in sizes:
+        nbr, _ = sample_layer_ref(indptr, indices, cur, k, rng)
+        frontier, col = reindex_layer_ref(cur, nbr)
+        rows, cols = [], []
+        for r in range(nbr.shape[0]):
+            for c in range(nbr.shape[1]):
+                if col[r, c] >= 0:
+                    rows.append(r)
+                    cols.append(col[r, c])
+        edge_index = np.stack([np.asarray(cols), np.asarray(rows)]) if rows else np.zeros((2, 0), np.int64)
+        layers.append((frontier, edge_index))
+        cur = frontier
+    return layers

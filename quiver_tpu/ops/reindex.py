@@ -1,0 +1,109 @@
+"""Order-preserving deduplication with static shapes.
+
+TPU-native replacement for the reference's GPU hash-table reindex
+(torch-quiver reindex.cu.hpp:17-225 + ``FillWithDuplicates``,
+quiver_sample.cu:18-63): instead of atomicCAS open addressing, a stable
+sort + segment-representative scan assigns every id the position of its first
+occurrence, producing the same order-preserving compaction with fully static
+shapes and no atomics. Seeds are placed first in the input, so — exactly as
+in the reference's ``reindex_with_seeds`` — the first ``num_seeds`` unique
+ids are the seeds themselves, preserving the PyG ``n_id[:batch_size]``
+contract.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["masked_unique", "reindex_layer"]
+
+
+def masked_unique(ids, valid, size: int, num_forced: int = 0):
+    """First-occurrence-order unique of ``ids[valid]``, padded to ``size``.
+
+    Args:
+      ids: (T,) integer ids (values < iinfo.max; padding may be anything).
+      valid: (T,) bool mask.
+      size: static output capacity for the unique list.
+      num_forced: the first ``num_forced`` valid lanes are *unconditionally*
+        kept as distinct outputs even if their values repeat. Used for seed
+        lanes: PyG's contract is ``n_id[:batch_size] == seeds`` verbatim,
+        duplicates included, so a batch like [7, 7, 3] must occupy three
+        output slots. Later duplicates of a forced value still map to its
+        first occurrence.
+
+    Returns:
+      uniq: (size,) unique ids in first-occurrence order, -1 padded.
+      num_unique: scalar — total uniques found (may exceed ``size``; the
+        excess is reported, not stored).
+      local: (T,) compact id of each element among the uniques, or -1 for
+        invalid / overflowed elements.
+    """
+    T = ids.shape[0]
+    sent = jnp.iinfo(ids.dtype).max
+    vals = jnp.where(valid, ids, sent)
+    pos = jnp.arange(T, dtype=jnp.int32)
+
+    order = jnp.argsort(vals, stable=True)
+    sv = vals[order]
+    pv = pos[order]
+
+    # run starts in the sorted view (sentinel run excluded)
+    first = jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]]) & (sv != sent)
+    run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+    # representative position (== first occurrence, because the sort is
+    # stable and positions within a run are ascending) scattered per run
+    by_run = (
+        jnp.zeros(T, jnp.int32)
+        .at[jnp.where(first, run_id, T)]
+        .set(pv, mode="drop")
+    )
+    rep_pos_sorted = by_run[jnp.clip(run_id, 0)]
+    # back to original positions
+    rep_pos = jnp.zeros(T, jnp.int32).at[order].set(rep_pos_sorted)
+
+    forced = (pos < num_forced) & valid
+    is_rep = (valid & (rep_pos == pos)) | forced
+    rank = jnp.cumsum(is_rep.astype(jnp.int32)) - 1  # first-occurrence rank
+    num_unique = jnp.sum(is_rep.astype(jnp.int32))
+
+    uniq = (
+        jnp.full(size, -1, ids.dtype)
+        .at[jnp.where(is_rep & (rank < size), rank, size)]
+        .set(ids, mode="drop")
+    )
+    local = rank[rep_pos]
+    local = jnp.where(valid & (local < size), local, -1)
+    return uniq, num_unique, local
+
+
+def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int):
+    """Per-layer reindex: frontier = unique(seeds ∪ neighbors), seeds first.
+
+    Mirrors the reference's ``reindex_single`` contract
+    (quiver_sample.cu:294-346) in padded form.
+
+    Args:
+      seeds: (S,) seed node ids, -1 padded; valid entries occupy a prefix.
+      num_seeds: scalar count of valid seeds.
+      neighbors: (S, K) sampled neighbor ids, -1 where invalid.
+      frontier_cap: static capacity of the output frontier.
+
+    Returns:
+      frontier: (frontier_cap,) unique node ids, seeds first, -1 padded.
+      num_frontier: scalar valid count (clipped to capacity).
+      col_local: (S, K) frontier-local id per neighbor, -1 where invalid.
+        (Row-local ids need no lookup: seed i's local id is i.)
+      overflow: scalar count of uniques dropped for exceeding frontier_cap.
+    """
+    S, K = neighbors.shape
+    ids = jnp.concatenate([seeds, neighbors.reshape(-1)])
+    seed_valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
+    nbr_valid = neighbors.reshape(-1) >= 0
+    valid = jnp.concatenate([seed_valid, nbr_valid])
+
+    uniq, num_unique, local = masked_unique(ids, valid, frontier_cap, num_forced=S)
+    col_local = local[S:].reshape(S, K)
+    num_frontier = jnp.minimum(num_unique, frontier_cap)
+    overflow = jnp.maximum(num_unique - frontier_cap, 0)
+    return uniq, num_frontier, col_local, overflow

@@ -1,0 +1,146 @@
+"""Fixed-fanout neighbor sampling, XLA-native with static shapes.
+
+TPU-native replacement for the reference's warp-per-row reservoir kernel
+(torch-quiver cuda_random.cu.hpp:7-69 ``CSRRowWiseSampleKernel``) and its
+driver (quiver_sample.cu:100-187). Design divergence from the reference
+(SURVEY §7.1): outputs are padded ``(S, K)`` blocks with -1 sentinels instead
+of ragged flat-list + counts, so everything jits.
+
+Sampling scheme for ``deg > k`` (the reference uses per-warp curand
+reservoir sampling): **stratified offsets + uniform random rotation**.
+Split ``[0, deg)`` into k contiguous integer strata, pick one jittered point
+per stratum, then rotate the whole set by ``r ~ U[0, deg)`` mod deg.
+Properties:
+  * the k offsets are distinct (strata are disjoint; rotation is a bijection),
+  * every neighbor's inclusion probability is exactly ``k/deg`` (rotation
+    symmetry), matching the reservoir's first-order marginals,
+  * fully vectorized — no per-row loops, no atomics, no rejection.
+Higher-order joint inclusion differs from true reservoir sampling (offsets
+are negatively correlated within a row, which if anything *reduces* estimator
+variance for mean aggregation).
+
+For ``deg <= k`` all neighbors are taken, like the reference's copy-all branch
+(cuda_random.cu.hpp:30-39).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_layer"]
+
+
+def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False):
+    """Sample up to ``k`` neighbors for each valid seed.
+
+    Args:
+      topo: DeviceTopology (indptr (N+1,), indices (E,)).
+      seeds: (S,) node ids, -1 padded; valid entries occupy a prefix.
+      num_seeds: scalar count of valid seeds.
+      k: static fanout. Must be >= 1 (use max_degree for full neighborhood,
+         the reference's fanout -1, sage_sampler.py:67).
+      key: PRNG key.
+      with_eid: also return global CSR edge positions per sample.
+
+    Returns:
+      neighbors: (S, K) sampled node ids, -1 where invalid.
+      counts: (S,) number of valid samples per row (min(deg, k), 0 for
+        invalid seeds) — the padded analogue of the reference's counts output.
+      eids: (S, K) CSR edge slots or -1, only if ``with_eid``.
+    """
+    if k < 1:
+        raise ValueError(f"fanout k must be >= 1, got {k}")
+    if k > 46340:
+        # the int32 stratum arithmetic below needs i*r_ <= k^2 < 2^31
+        raise ValueError(f"fanout k must be <= 46340, got {k}")
+    S = seeds.shape[0]
+    valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
+    s = jnp.where(valid, seeds, 0)
+
+    base = topo.indptr[s]
+    deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
+    deg = jnp.where(valid, deg, 0)
+
+    i = jnp.arange(k, dtype=jnp.int32)[None, :]  # (1, K)
+    degc = deg[:, None]  # (S, 1)
+
+    # --- deg > k path: stratified + rotation ---------------------------
+    # Stratum boundary lo(i) = floor(deg*i/k), computed overflow-free in
+    # int32 via the decomposition i*(deg//k) + floor(i*(deg%k)/k): every
+    # intermediate is <= deg (< 2^31) for fanouts k <= 46340.
+    q, r_ = degc // k, degc % k
+    lo = i * q + (i * r_) // k
+    hi = (i + 1) * q + ((i + 1) * r_) // k
+    span = jnp.maximum(hi - lo, 1)
+    kj, kr = jax.random.split(key)
+    jitter = jax.random.randint(kj, (S, k), 0, span, dtype=jnp.int32)
+    rot = jax.random.randint(kr, (S, 1), 0, jnp.maximum(degc, 1), dtype=jnp.int32)
+    # (lo + jitter) < deg and rot < deg, so the sum is < 2*deg: one
+    # conditional subtract replaces the mod without overflow.
+    shifted = lo + jitter + rot
+    off_sampled = jnp.where(shifted >= degc, shifted - degc, shifted)
+
+    # --- deg <= k path: take-all ---------------------------------------
+    take_all = degc <= k
+    off = jnp.where(take_all, i, off_sampled)
+    mask = valid[:, None] & (i < jnp.minimum(degc, k))
+
+    epos = base[:, None] + off.astype(base.dtype)
+    safe_epos = jnp.where(mask, epos, 0)
+    nbr = _gather_indices(topo, safe_epos)
+    nbr = jnp.where(mask, nbr, -1).astype(jnp.int32)
+    counts = jnp.where(valid, jnp.minimum(deg, k), 0)
+
+    if with_eid:
+        eids = jnp.where(mask, epos, -1)
+        if topo.eid is not None:
+            eids = jnp.where(
+                mask, staged_gather(topo.eid, safe_epos, topo.host_indices), -1
+            )
+        return nbr, counts, eids
+    return nbr, counts
+
+
+def _gather_indices(topo, epos):
+    return staged_gather(topo.indices, epos, getattr(topo, "host_indices", False))
+
+
+def staged_gather(table, idx, host: bool):
+    """Gather rows of ``table``, staging through host memory when ``host``.
+
+    The reference's UVA mode lets the sampling kernel dereference pinned host
+    memory directly over PCIe (quiver_sample.cu:400-408). TPUs cannot do
+    that, so the HOST-mode equivalent is a *staged* gather: the (small) index
+    block hops to host memory, the gather runs as host compute against the
+    host-resident table, and only the result returns to HBM — the large
+    table itself never transits.
+    """
+    if not host:
+        return table[idx]
+    if isinstance(idx, jax.core.Tracer):
+        return _staged_gather(table, idx)
+    # eager call: compute_on leaves a host memory space in the result aval
+    # that later eager ops reject, so jit the whole stage (the jit boundary
+    # re-anchors the result in device space)
+    return _staged_gather_jit(table, idx)
+
+
+_staged_gather_jit = jax.jit(lambda t, i: _staged_gather(t, i))
+
+
+def _staged_gather(table, idx):
+    from jax.experimental.compute_on import compute_on
+    from jax.sharding import SingleDeviceSharding
+
+    dev = jax.devices()[0]
+    host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
+    dev_s = SingleDeviceSharding(dev, memory_kind="device")
+    idx_h = jax.device_put(idx, host_s)
+
+    @compute_on("device_host")
+    def host_gather(t, i):
+        return t[i]
+
+    out_h = host_gather(table, idx_h)
+    return jax.device_put(out_h, dev_s)

@@ -1,0 +1,73 @@
+"""Training-step factories.
+
+The reference leaves the training loop to user scripts (PyG model + DDP +
+NCCL allreduce, examples/multi_gpu/pyg/ogb-products/
+dist_sampling_ogb_products_quiver.py:82-136). quiver-tpu ships the loop as a
+library: a jitted step combining feature lookup, model forward/backward, and
+optimizer update. Data parallelism is expressed with shardings on the same
+step (see parallel/mesh.py) — gradient psum over ICI replaces the DDP
+allreduce, inserted by XLA from the sharding annotations.
+
+Label convention: only the first ``batch_size`` rows of ``n_id`` are labeled
+seeds (reference ``n_id[:batch_size]``, dist_sampling_ogb_products_quiver.py:115);
+padding rows get zero loss weight.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["make_train_step", "make_eval_step", "init_model"]
+
+
+def init_model(model, rng, x, adjs):
+    variables = model.init({"params": rng}, x, adjs)
+    return variables["params"]
+
+
+def cross_entropy_on_seeds(logits, labels, label_mask):
+    """Mean NLL over valid seed rows (logits are log-probs)."""
+    lab = jnp.clip(labels, 0)
+    ll = jnp.take_along_axis(logits, lab[:, None], axis=1)[:, 0]
+    w = label_mask.astype(logits.dtype)
+    return -(ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def make_train_step(model, tx: optax.GradientTransformation) -> Callable:
+    """Build a jit-ready SGD step: (params, opt_state, x, adjs, labels,
+    label_mask, rng) -> (params, opt_state, loss).
+
+    Not jitted here so callers can wrap it with their own shardings
+    (jax.jit / shard_map); ``jax.jit`` it directly for single-chip use.
+    """
+
+    def train_step(params, opt_state, x, adjs, labels, label_mask, rng):
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p}, x, adjs, train=True, rngs={"dropout": rng}
+            )
+            return cross_entropy_on_seeds(logits, labels, label_mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable:
+    """(params, x, adjs, labels, label_mask) -> (num_correct, num_valid)."""
+
+    def eval_step(params, x, adjs, labels, label_mask):
+        logits = model.apply({"params": params}, x, adjs, train=False)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = ((pred == labels) & label_mask).sum()
+        return correct, label_mask.sum()
+
+    return eval_step

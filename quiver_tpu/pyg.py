@@ -1,0 +1,6 @@
+"""PyG-style import path parity: ``from quiver_tpu.pyg import GraphSageSampler``
+mirrors the reference's ``quiver.pyg`` subpackage (pyg/sage_sampler.py)."""
+
+from .sampling.sampler import Adj, GraphSageSampler
+
+__all__ = ["Adj", "GraphSageSampler"]

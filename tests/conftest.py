@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The image's sitecustomize registers the axon TPU plugin at interpreter
+startup and pins jax to it, so an env-var override is too late by the time
+conftest runs; ``jax.config.update`` after import still works because backend
+initialization is lazy. XLA_FLAGS must be set before the first backend touch.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,88 @@
+"""Feature store tests: gather-vs-dense differential (the reference's oracle
+pattern, test_features.py:338-339 `np.array_equal(res, tensor[indices])`),
+budget parsing, reorder integration, cold-tier correctness."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.feature.feature import Feature
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+
+def _table(n=200, f=8, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, f)).astype(np.float32)
+
+
+def test_all_hot_matches_dense():
+    t = _table()
+    feat = Feature(device_cache_size="1G").from_cpu_tensor(t)
+    assert feat.hot_rows == 200 and feat.cold is None
+    ids = np.random.default_rng(1).integers(0, 200, 64)
+    out = np.asarray(feat[jnp.asarray(ids)])
+    assert np.allclose(out, t[ids])
+
+
+def test_all_cold_matches_dense():
+    t = _table()
+    feat = Feature(device_cache_size=0).from_cpu_tensor(t)
+    assert feat.hot is None and feat.cold is not None
+    ids = np.random.default_rng(2).integers(0, 200, 50)
+    out = np.asarray(feat[jnp.asarray(ids)])
+    assert np.allclose(out, t[ids])
+
+
+def test_mixed_tiers_match_dense():
+    t = _table()
+    row_bytes = 8 * 4
+    feat = Feature(device_cache_size=60 * row_bytes).from_cpu_tensor(t)
+    assert feat.hot_rows == 60
+    assert feat.hot.shape == (60, 8) and feat.cold.shape == (140, 8)
+    ids = np.random.default_rng(3).integers(0, 200, 100)
+    out = np.asarray(feat[jnp.asarray(ids)])
+    assert np.allclose(out, t[ids])
+
+
+def test_invalid_ids_zero_rows():
+    t = _table()
+    feat = Feature(device_cache_size="1M").from_cpu_tensor(t)
+    ids = jnp.array([3, -1, 7, -1])
+    out = np.asarray(feat[ids])
+    assert np.allclose(out[0], t[3]) and np.allclose(out[2], t[7])
+    assert np.all(out[1] == 0) and np.all(out[3] == 0)
+
+
+def test_degree_reorder_transparent():
+    # with csr_topo, Feature reorders rows hot-first but lookups by original
+    # id must still return the original rows (feature_order translation,
+    # reference feature.py:184-195)
+    ei = generate_pareto_graph(200, 6.0, seed=5)
+    topo = CSRTopo(edge_index=ei)
+    t = _table(topo.node_count, 8)
+    row_bytes = 8 * 4
+    feat = Feature(device_cache_size=50 * row_bytes, csr_topo=topo).from_cpu_tensor(t)
+    assert topo.feature_order is not None
+    ids = np.random.default_rng(4).integers(0, topo.node_count, 80)
+    out = np.asarray(feat[jnp.asarray(ids)])
+    assert np.allclose(out, t[ids])
+    # hot tier actually holds the high-degree nodes
+    deg = topo.degree
+    hot_nodes = np.where(np.asarray(feat.feature_order) < feat.hot_rows)[0]
+    cold_nodes = np.where(np.asarray(feat.feature_order) >= feat.hot_rows)[0]
+    assert deg[hot_nodes].min() >= deg[cold_nodes].max()
+
+
+def test_lookup_inside_jit():
+    t = _table()
+    feat = Feature(device_cache_size=100 * 8 * 4).from_cpu_tensor(t)
+
+    @jax.jit
+    def f(feat, ids):
+        return feat[ids].sum(axis=1)
+
+    ids = jnp.array([1, 5, 150, -1])
+    out = np.asarray(f(feat, ids))
+    expect = t[[1, 5, 150]].sum(axis=1)
+    assert np.allclose(out[:3], expect, rtol=1e-5)
+    assert out[3] == 0

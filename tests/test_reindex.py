@@ -1,0 +1,100 @@
+"""Differential + property tests for order-preserving masked unique / reindex.
+
+Oracle: the hash-map reference in ops/cpu_ref.py (parity with the reference's
+reindex_group, quiver.cpp:39-84).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from quiver_tpu.ops.reindex import masked_unique, reindex_layer
+from quiver_tpu.ops.cpu_ref import reindex_layer_ref
+
+
+def _first_occurrence_unique(xs):
+    seen, out = set(), []
+    for x in xs:
+        if x not in seen:
+            seen.add(x)
+            out.append(x)
+    return out
+
+
+def test_masked_unique_basic():
+    ids = jnp.array([5, 3, 5, 7, 3, 9])
+    valid = jnp.ones(6, bool)
+    uniq, n, local = masked_unique(ids, valid, size=8)
+    assert n == 4
+    assert list(uniq[:4]) == [5, 3, 7, 9]
+    assert list(uniq[4:]) == [-1, -1, -1, -1]
+    assert list(local) == [0, 1, 0, 2, 1, 3]
+
+
+def test_masked_unique_with_invalid():
+    ids = jnp.array([5, -1, 5, 7, -1, 5])
+    valid = ids >= 0
+    uniq, n, local = masked_unique(ids, valid, size=4)
+    assert n == 2
+    assert list(uniq[:2]) == [5, 7]
+    assert list(local) == [0, -1, 0, 1, -1, 0]
+
+
+def test_masked_unique_all_invalid():
+    ids = jnp.full(5, -1)
+    uniq, n, local = masked_unique(ids, ids >= 0, size=3)
+    assert n == 0
+    assert list(uniq) == [-1, -1, -1]
+    assert list(local) == [-1] * 5
+
+
+def test_masked_unique_overflow():
+    ids = jnp.array([1, 2, 3, 4, 5])
+    valid = jnp.ones(5, bool)
+    uniq, n, local = masked_unique(ids, valid, size=3)
+    assert n == 5  # total uniques reported even beyond capacity
+    assert list(uniq) == [1, 2, 3]
+    assert list(local) == [0, 1, 2, -1, -1]  # overflowed get -1
+
+
+def test_masked_unique_random_vs_python():
+    rng = np.random.default_rng(0)
+    for trial in range(10):
+        t = int(rng.integers(1, 200))
+        ids = rng.integers(0, 50, t)
+        valid = rng.random(t) < 0.8
+        uniq, n, local = masked_unique(jnp.asarray(ids), jnp.asarray(valid), size=t)
+        expect = _first_occurrence_unique(ids[valid].tolist())
+        assert int(n) == len(expect)
+        assert list(np.asarray(uniq[: len(expect)])) == expect
+        # local ids consistent: uniq[local[p]] == ids[p] for valid p
+        la = np.asarray(local)
+        ua = np.asarray(uniq)
+        for p in range(t):
+            if valid[p]:
+                assert ua[la[p]] == ids[p]
+            else:
+                assert la[p] == -1
+
+
+def test_reindex_layer_matches_reference():
+    rng = np.random.default_rng(1)
+    S, K = 16, 5
+    num_seeds = 11
+    seeds = np.full(S, -1, np.int64)
+    seeds[:num_seeds] = rng.choice(100, num_seeds, replace=False)
+    neighbors = rng.integers(0, 100, (S, K))
+    neighbors[num_seeds:] = -1
+    mask = rng.random((S, K)) < 0.7
+    neighbors = np.where(mask, neighbors, -1)
+    neighbors[num_seeds:] = -1
+
+    frontier, n_frontier, col, overflow = reindex_layer(
+        jnp.asarray(seeds), jnp.int32(num_seeds), jnp.asarray(neighbors), 128
+    )
+    ref_frontier, ref_col = reindex_layer_ref(seeds[:num_seeds], neighbors)
+    assert int(overflow) == 0
+    assert int(n_frontier) == len(ref_frontier)
+    assert np.array_equal(np.asarray(frontier[: len(ref_frontier)]), ref_frontier)
+    assert np.array_equal(np.asarray(col), ref_col)
+    # seeds-first contract: frontier[:num_seeds] == seeds
+    assert np.array_equal(np.asarray(frontier[:num_seeds]), seeds[:num_seeds])

@@ -1,0 +1,131 @@
+"""Sample-validity oracle tests for the XLA neighbor sampler.
+
+The oracle (reference test_quiver_cpu.cpp:9-75 pattern): every sampled
+neighbor must be a member of the seed's adjacency list, counts must equal
+min(deg, k), and rows with deg > k must have no duplicates. Plus a
+distributional check on inclusion frequency (the stratified+rotation scheme
+guarantees first-order inclusion probability k/deg).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu import CSRTopo, SampleMode
+from quiver_tpu.ops.sample import sample_layer
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+
+def _simple_graph(n, deg):
+    """Node i's neighbors are exactly {(j+1)*n + i | j in range(deg)} % V.
+
+    Deterministic membership check (reference test_quiver_cpu.cpp simple_graph).
+    """
+    row = np.repeat(np.arange(n), deg)
+    col = (np.arange(deg)[None, :] + 1) * n + np.arange(n)[:, None]
+    v = n * (deg + 1)
+    return np.stack([row, col.reshape(-1) % v]), v
+
+
+@pytest.mark.parametrize("n,deg,k", [(32, 3, 5), (32, 8, 8), (64, 12, 4)])
+def test_sample_validity(n, deg, k):
+    ei, v = _simple_graph(n, deg)
+    # pad indptr out to v+1 nodes so every id is a valid seed
+    topo = CSRTopo(edge_index=ei)
+    indptr = np.concatenate([topo.indptr, np.full(v - topo.node_count, topo.edge_count)])
+    topo = CSRTopo(indptr=indptr, indices=topo.indices)
+    dev = topo.to_device()
+
+    S = 48
+    seeds = np.full(S, -1, np.int32)
+    num = 40
+    seeds[:num] = np.random.default_rng(0).integers(0, n, num)
+    nbr, counts = sample_layer(dev, jnp.asarray(seeds), jnp.int32(num), k, jax.random.PRNGKey(0))
+    nbr, counts = np.asarray(nbr), np.asarray(counts)
+
+    adj = {i: set(((np.arange(deg) + 1) * n + i) % v) for i in range(n)}
+    for r in range(S):
+        if r >= num:
+            assert counts[r] == 0 and np.all(nbr[r] == -1)
+            continue
+        s = seeds[r]
+        expect = min(deg, k)
+        assert counts[r] == expect
+        got = nbr[r][nbr[r] >= 0]
+        assert len(got) == expect
+        assert set(got.tolist()) <= adj[s]
+        if deg > k:
+            assert len(set(got.tolist())) == k  # distinct when subsampling
+
+
+def test_sample_take_all_exact():
+    # deg <= k rows must return the full neighborhood in CSR order
+    ei, v = _simple_graph(16, 4)
+    topo = CSRTopo(edge_index=ei).to_device()
+    seeds = jnp.arange(10, dtype=jnp.int32)
+    nbr, counts = sample_layer(topo, seeds, jnp.int32(10), 6, jax.random.PRNGKey(1))
+    nbr = np.asarray(nbr)
+    for r in range(10):
+        assert np.array_equal(nbr[r, :4], ((np.arange(4) + 1) * 16 + r) % v)
+        assert np.all(nbr[r, 4:] == -1)
+
+
+def test_sample_zero_degree_and_padding():
+    indptr = np.array([0, 0, 2, 2])
+    indices = np.array([0, 2])
+    topo = CSRTopo(indptr=indptr, indices=indices).to_device()
+    seeds = jnp.array([0, 1, 2, -1], dtype=jnp.int32)
+    nbr, counts = sample_layer(topo, seeds, jnp.int32(3), 3, jax.random.PRNGKey(2))
+    assert list(np.asarray(counts)) == [0, 2, 0, 0]
+    assert np.all(np.asarray(nbr)[0] == -1)
+    assert np.all(np.asarray(nbr)[3] == -1)
+
+
+def test_inclusion_probability_uniform():
+    # one node with degree 20, fanout 5: each neighbor should appear with
+    # frequency ~ k/deg = 0.25 over many trials
+    deg, k, trials = 20, 5, 400
+    # node 0 has `deg` neighbors (ids 100..119); nodes 1..119 are isolated
+    indptr = np.concatenate([[0], np.full(120, deg)])
+    indices = np.arange(100, 100 + deg)
+    topo = CSRTopo(indptr=indptr, indices=indices).to_device()
+    seeds = jnp.zeros(1, jnp.int32)
+    counts = np.zeros(deg)
+    for t in range(trials):
+        nbr, _ = sample_layer(topo, seeds, jnp.int32(1), k, jax.random.PRNGKey(t))
+        got = np.asarray(nbr)[0]
+        got = got[got >= 0] - 100
+        assert len(set(got.tolist())) == k
+        counts[got] += 1
+    freq = counts / trials
+    # expected 0.25; binomial std ≈ sqrt(.25*.75/400) ≈ 0.0217 → 5 sigma
+    assert np.all(np.abs(freq - k / deg) < 0.11), freq
+
+
+def test_sample_with_eid():
+    ei, v = _simple_graph(8, 3)
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device(with_eid=True)
+    seeds = jnp.arange(5, dtype=jnp.int32)
+    nbr, counts, eids = sample_layer(dev, seeds, jnp.int32(5), 2, jax.random.PRNGKey(0), with_eid=True)
+    nbr, eids = np.asarray(nbr), np.asarray(eids)
+    # each returned eid must point at the COO edge (seed -> neighbor)
+    for r in range(5):
+        for c in range(2):
+            if eids[r, c] >= 0:
+                assert ei[0, eids[r, c]] == r
+                assert ei[1, eids[r, c]] == nbr[r, c]
+
+
+def test_host_mode_matches_hbm_mode():
+    ei = generate_pareto_graph(500, 6.0, seed=3)
+    topo = CSRTopo(edge_index=ei)
+    hbm = topo.to_device(SampleMode.HBM)
+    host = topo.to_device(SampleMode.HOST)
+    seeds = jnp.asarray(np.random.default_rng(0).integers(0, 500, 64), dtype=jnp.int32)
+    key = jax.random.PRNGKey(9)
+    a, ca = sample_layer(hbm, seeds, jnp.int32(64), 4, key)
+    b, cb = sample_layer(host, seeds, jnp.int32(64), 4, key)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(ca), np.asarray(cb))
