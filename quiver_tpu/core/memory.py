@@ -10,19 +10,31 @@ import jax.numpy as jnp
 __all__ = ["to_pinned_host"]
 
 
-def to_pinned_host(x: np.ndarray) -> tuple[jax.Array, bool]:
+def to_pinned_host(x: np.ndarray, mesh=None) -> tuple[jax.Array, bool]:
     """Place an array in pinned host memory if the platform supports it.
 
-    Returns (array, is_host). Falls back to default device placement with
+    With ``mesh``, the host array is replicated across the mesh's devices
+    (one physical copy per host) so it composes with mesh-sharded arrays.
+    Returns (array, is_host). Falls back to default placement with
     is_host=False on platforms without a pinned_host memory space — callers
     branch on the flag to pick direct vs staged gathers.
     """
-    dev = jax.devices()[0]
     try:
-        s = jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host")
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            s = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
+        else:
+            s = jax.sharding.SingleDeviceSharding(
+                jax.devices()[0], memory_kind="pinned_host"
+            )
         arr = jax.device_put(np.asarray(x), s)
         if getattr(arr.sharding, "memory_kind", None) == "pinned_host":
             return arr, True
     except Exception:
         pass
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(np.asarray(x), NamedSharding(mesh, PartitionSpec())), False
     return jnp.asarray(x), False

@@ -37,7 +37,31 @@ from ..core.topology import CSRTopo
 from ..ops.sample import staged_gather
 from ..utils.reorder import reorder_by_degree
 
-__all__ = ["Feature"]
+__all__ = ["Feature", "tiered_lookup"]
+
+
+def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather):
+    """Shared hot/cold tier-merge used by Feature and ShardedFeature.
+
+    ``hot_gather``/``cold_gather`` are callables (ids) -> rows, either may be
+    None. Invalid lanes (-1) return zero rows; lanes belonging to the other
+    tier are pointed at row 0 so their bandwidth collapses to one cached row.
+    """
+    n_id = jnp.asarray(n_id)
+    valid = n_id >= 0
+    ids = jnp.where(valid, n_id, 0)
+    if feature_order is not None:
+        ids = feature_order[ids]
+    if cold_gather is None:
+        out = hot_gather(ids)
+    elif hot_gather is None:
+        out = cold_gather(ids)
+    else:
+        is_hot = ids < hot_rows
+        hot_part = hot_gather(jnp.where(is_hot, ids, 0))
+        cold_part = cold_gather(jnp.where(is_hot, 0, ids - hot_rows))
+        out = jnp.where(is_hot[:, None], hot_part, cold_part)
+    return jnp.where(valid[:, None], out, 0)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -116,24 +140,15 @@ class Feature:
 
         Jit-composable; invalid lanes return zero rows.
         """
-        n_id = jnp.asarray(n_id)
-        valid = n_id >= 0
-        ids = jnp.where(valid, n_id, 0)
-        if self.feature_order is not None:
-            ids = self.feature_order[ids]
-
-        if self.cold is None:
-            out = self.hot[ids]
-        elif self.hot is None:
-            out = staged_gather(self.cold, ids, self._cold_is_host)
-        else:
-            is_hot = ids < self.hot_rows
-            hot_idx = jnp.where(is_hot, ids, 0)
-            cold_idx = jnp.where(is_hot, 0, ids - self.hot_rows)
-            hot_part = self.hot[hot_idx]
-            cold_part = staged_gather(self.cold, cold_idx, self._cold_is_host)
-            out = jnp.where(is_hot[:, None], hot_part, cold_part)
-        return jnp.where(valid[:, None], out, 0)
+        hot_gather = None if self.hot is None else lambda ids: self.hot[ids]
+        cold_gather = (
+            None
+            if self.cold is None
+            else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
+        )
+        return tiered_lookup(
+            n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather
+        )
 
     def size(self, dim: int) -> int:
         return self.shape[dim]

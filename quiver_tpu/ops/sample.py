@@ -106,7 +106,7 @@ def _gather_indices(topo, epos):
     return staged_gather(topo.indices, epos, getattr(topo, "host_indices", False))
 
 
-def staged_gather(table, idx, host: bool):
+def staged_gather(table, idx, host: bool, mesh=None):
     """Gather rows of ``table``, staging through host memory when ``host``.
 
     The reference's UVA mode lets the sampling kernel dereference pinned host
@@ -114,28 +114,33 @@ def staged_gather(table, idx, host: bool):
     that, so the HOST-mode equivalent is a *staged* gather: the (small) index
     block hops to host memory, the gather runs as host compute against the
     host-resident table, and only the result returns to HBM — the large
-    table itself never transits.
+    table itself never transits. With ``mesh``, shardings are mesh-wide
+    (replicated) so results compose with mesh-sharded arrays.
     """
     if not host:
         return table[idx]
     if isinstance(idx, jax.core.Tracer):
-        return _staged_gather(table, idx)
+        return _staged_gather(table, idx, mesh)
     # eager call: compute_on leaves a host memory space in the result aval
     # that later eager ops reject, so jit the whole stage (the jit boundary
     # re-anchors the result in device space)
-    return _staged_gather_jit(table, idx)
+    return _staged_gather_jit(table, idx, mesh)
 
 
-_staged_gather_jit = jax.jit(lambda t, i: _staged_gather(t, i))
-
-
-def _staged_gather(table, idx):
+def _staged_gather(table, idx, mesh=None):
     from jax.experimental.compute_on import compute_on
-    from jax.sharding import SingleDeviceSharding
 
-    dev = jax.devices()[0]
-    host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
-    dev_s = SingleDeviceSharding(dev, memory_kind="device")
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        host_s = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
+        dev_s = NamedSharding(mesh, PartitionSpec(), memory_kind="device")
+    else:
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.devices()[0]
+        host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
+        dev_s = SingleDeviceSharding(dev, memory_kind="device")
     idx_h = jax.device_put(idx, host_s)
 
     @compute_on("device_host")
@@ -144,3 +149,8 @@ def _staged_gather(table, idx):
 
     out_h = host_gather(table, idx_h)
     return jax.device_put(out_h, dev_s)
+
+
+# module-level wrapper so repeated eager calls hit the jit dispatch fastpath
+# (Mesh is hashable, so it can ride as a static arg)
+_staged_gather_jit = jax.jit(_staged_gather, static_argnums=2)

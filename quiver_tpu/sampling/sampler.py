@@ -79,6 +79,32 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps):
+    """The multi-layer sample+reindex loop (jit- and shard_map-composable).
+
+    One trace covers all layers — the fused analogue of the reference's
+    per-hop Python loop of C++ calls (sage_sampler.py:84-112). Shapes are
+    fully static: ``sizes`` and ``caps`` are tuples of ints.
+
+    Returns (n_id, n_count, adjs deepest-first, overflow).
+    """
+    adjs = []
+    cur, cur_n = seeds, num_seeds
+    total_overflow = jnp.zeros((), jnp.int32)
+    for l, k in enumerate(sizes):
+        key, sub = jax.random.split(key)
+        nbr, _ = sample_layer(topo, cur, cur_n, k, sub)
+        frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
+        S = cur.shape[0]
+        row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
+        row = jnp.where(col >= 0, row, -1)
+        edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
+        adjs.append(Adj(edge_index, None, (caps[l], S)))
+        cur, cur_n = frontier, n_frontier
+        total_overflow = total_overflow + overflow
+    return cur, cur_n, adjs[::-1], total_overflow
+
+
 class GraphSageSampler:
     """K-hop neighbor sampler over a device-resident CSR topology.
 
@@ -152,25 +178,7 @@ class GraphSageSampler:
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
-            adjs = []
-            cur, cur_n = seeds, num_seeds
-            total_overflow = jnp.zeros((), jnp.int32)
-            for l, k in enumerate(sizes):
-                key, sub = jax.random.split(key)
-                nbr, _ = sample_layer(topo, cur, cur_n, k, sub)
-                frontier, n_frontier, col, overflow = reindex_layer(
-                    cur, cur_n, nbr, caps[l]
-                )
-                S = cur.shape[0]
-                row = jnp.broadcast_to(
-                    jnp.arange(S, dtype=jnp.int32)[:, None], (S, k)
-                )
-                row = jnp.where(col >= 0, row, -1)
-                edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
-                adjs.append(Adj(edge_index, None, (caps[l], S)))
-                cur, cur_n = frontier, n_frontier
-                total_overflow = total_overflow + overflow
-            return cur, cur_n, adjs[::-1], total_overflow
+            return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps)
 
         self._compiled_cache[seed_cap] = (run, caps)
         return run, caps

@@ -1,0 +1,87 @@
+"""Fused SPMD training tests on the 8-device virtual mesh — the multi-chip
+data-parallel + sharded-feature configuration (SURVEY §7.2 step 7), which the
+reference could only test on real multi-GPU boxes (SURVEY §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.feature.feature import Feature
+from quiver_tpu.feature.shard import ShardedFeature
+from quiver_tpu.models.sage import GraphSAGE
+from quiver_tpu.parallel.mesh import make_mesh
+from quiver_tpu.parallel.trainer import DistributedTrainer
+
+
+def _labeled_graph(n=400, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    feat = np.eye(classes, dtype=np.float32)[labels] * 2.0
+    feat += rng.normal(scale=0.8, size=(n, classes)).astype(np.float32)
+    rows, cols = [], []
+    for c in range(classes):
+        members = np.where(labels == c)[0]
+        rows.extend(rng.choice(members, 6 * len(members)))
+        cols.extend(rng.choice(members, 6 * len(members)))
+    ei = np.stack([np.asarray(rows), np.asarray(cols)])
+    return ei, feat, labels
+
+
+@pytest.mark.parametrize("feature_kind", ["replicate", "shard"])
+def test_fused_training_learns(feature_kind):
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [5, 5], seed=3)
+    if feature_kind == "replicate":
+        feature = Feature(device_cache_size="1G").from_cpu_tensor(feat[:n])
+    else:
+        feature = ShardedFeature(mesh, device_cache_size="1G").from_cpu_tensor(feat[:n])
+
+    model = GraphSAGE(hidden=32, num_classes=4, num_layers=2)
+    tx = optax.adam(5e-3)
+    trainer = DistributedTrainer(mesh, sampler, feature, model, tx, local_batch=64)
+    params, opt_state = trainer.init(jax.random.PRNGKey(0))
+
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    rng = np.random.default_rng(0)
+    losses = []
+    for step in range(25):
+        seeds = rng.integers(0, n, 256)  # 4 data shards x 64
+        params, opt_state, loss = trainer.step(
+            params, opt_state, seeds, labels_dev, jax.random.PRNGKey(step)
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.75, losses
+
+
+def test_fused_rejects_cold_tier():
+    ei, feat, labels = _labeled_graph(n=100)
+    topo = CSRTopo(edge_index=ei)
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [3], seed=0)
+    feature = Feature(device_cache_size=10 * 16).from_cpu_tensor(feat[: topo.node_count])
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=1)
+    with pytest.raises(ValueError, match="device-resident"):
+        DistributedTrainer(mesh, sampler, feature, model, optax.sgd(0.1))
+
+
+def test_shard_seeds_packing():
+    ei, feat, labels = _labeled_graph(n=100)
+    topo = CSRTopo(edge_index=ei)
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [3], seed=0)
+    feature = Feature(device_cache_size="1M").from_cpu_tensor(feat[: topo.node_count])
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=1)
+    trainer = DistributedTrainer(mesh, sampler, feature, model, optax.sgd(0.1), local_batch=8)
+    packed = trainer.shard_seeds(np.arange(20))
+    blocks = packed.reshape(4, 8)
+    # valid-prefix blocks, -1 padded
+    for b in blocks:
+        valid = b[b >= 0]
+        assert np.all(b[: len(valid)] == valid)
+    assert np.array_equal(np.sort(packed[packed >= 0]), np.arange(20))
