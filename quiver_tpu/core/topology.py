@@ -36,6 +36,28 @@ def _index_dtype(max_value: int) -> np.dtype:
     return np.dtype(np.int32) if max_value <= np.iinfo(np.int32).max else np.dtype(np.int64)
 
 
+def _build_csr(row, col, node_count: int, use_native: bool):
+    """COO -> CSR. Prefers the native linear-time parallel builder
+    (native/quiver_host.cpp csr_from_coo); falls back to numpy stable
+    argsort. Intra-row neighbor order is unspecified (the native scatter is
+    unordered across threads); ``eid`` is the authoritative CSR-slot -> COO
+    mapping either way."""
+    if use_native and node_count <= np.iinfo(np.int32).max:
+        try:
+            from ..native import available, csr_from_coo
+        except ImportError:
+            available = False
+        if available:
+            # real failures inside the native builder must propagate, not
+            # silently fall back
+            return csr_from_coo(row, col, node_count)
+    order = np.argsort(row, kind="stable")
+    counts = np.bincount(row, minlength=node_count)
+    indptr = np.zeros(node_count + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr, np.ascontiguousarray(col[order]), order
+
+
 class CSRTopo:
     """CSR graph topology with degree and feature-order bookkeeping.
 
@@ -44,7 +66,8 @@ class CSRTopo:
     the original COO edge positions (identity when built from indptr/indices).
     """
 
-    def __init__(self, edge_index=None, indptr=None, indices=None, eid=None):
+    def __init__(self, edge_index=None, indptr=None, indices=None, eid=None,
+                 use_native: bool = True):
         if edge_index is not None:
             if indptr is not None or indices is not None:
                 raise ValueError("pass either edge_index or indptr/indices, not both")
@@ -53,12 +76,7 @@ class CSRTopo:
                 raise ValueError(f"edge_index must be (2, E), got {edge_index.shape}")
             row, col = edge_index[0], edge_index[1]
             node_count = int(max(row.max(initial=-1), col.max(initial=-1)) + 1)
-            order = np.argsort(row, kind="stable")
-            counts = np.bincount(row, minlength=node_count)
-            indptr = np.zeros(node_count + 1, dtype=np.int64)
-            np.cumsum(counts, out=indptr[1:])
-            indices = np.ascontiguousarray(col[order])
-            eid = order
+            indptr, indices, eid = _build_csr(row, col, node_count, use_native)
         elif indptr is not None and indices is not None:
             indptr = _as_numpy(indptr).astype(np.int64, copy=False)
             indices = _as_numpy(indices)

@@ -1,0 +1,87 @@
+"""Pallas row-gather kernel: feature collection from an HBM-resident table.
+
+TPU-native equivalent of the reference's ``quiver_tensor_gather`` CUDA kernel
+(torch-quiver shard_tensor.cu.hpp:16-58 — warp per output row, UVA loads):
+here each grid step serves a tile of output rows by issuing one async DMA per
+row straight from the HBM table into the output's VMEM block, with all DMAs
+of a tile in flight simultaneously (the DMA engines play the role of the
+GPU's coalesced warp loads). Row indices arrive via scalar prefetch so the
+DMA addresses are known before the kernel body runs
+(pltpu.PrefetchScalarGridSpec).
+
+XLA's stock gather lowers to a serial dynamic-slice loop on TPU for this
+pattern; the explicit fan-out of row DMAs is where the win comes from.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["gather_rows"]
+
+
+def _gather_kernel(tile: int, ids_ref, table_ref, out_ref, sems):
+    i = pl.program_id(0)
+
+    def dma(j):
+        idx = ids_ref[i * tile + j]
+        return pltpu.make_async_copy(table_ref.at[idx], out_ref.at[j], sems.at[j])
+
+    # fan out: all row DMAs of this tile in flight at once
+    for j in range(tile):
+        dma(j).start()
+    for j in range(tile):
+        dma(j).wait()
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _gather_rows_impl(table, ids, tile: int, interpret: bool):
+    n_ids = ids.shape[0]
+    f = table.shape[1]
+    grid = (n_ids // tile,)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table stays in HBM
+        out_specs=pl.BlockSpec(
+            (tile, f), lambda i, ids: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((tile,))],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, tile),
+        out_shape=jax.ShapeDtypeStruct((n_ids, f), table.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(has_side_effects=False)
+        if not interpret
+        else None,
+    )(ids, table)
+
+
+def gather_rows(table, ids, tile: int = 16, interpret: bool | None = None):
+    """Gather ``table[ids]`` with explicit row-DMA pipelining.
+
+    Args:
+      table: (N, F) array in HBM. F should be a multiple of 128 for full
+        DMA efficiency (pad the feature dim at load time).
+      ids: (B,) int32 row indices; must be in-range (callers mask/clamp).
+      tile: rows per grid step (= DMAs in flight).
+      interpret: force interpreter mode; defaults to True off-TPU so the
+        kernel stays testable on the virtual CPU mesh.
+
+    Returns (B, F) gathered rows.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n = ids.shape[0]
+    pad = (-n) % tile
+    if pad:
+        ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
+    out = _gather_rows_impl(table, ids, tile, interpret)
+    return out[:n] if pad else out

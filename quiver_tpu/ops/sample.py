@@ -28,7 +28,47 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_layer"]
+__all__ = ["sample_layer", "stratified_offsets", "staged_gather"]
+
+
+def stratified_offsets(key, deg, k: int):
+    """k distinct offsets per row: one jittered pick per integer stratum.
+
+    Returns (offsets (S, k) int32 in [0, max(deg,1)), sel_mask (S, k) with
+    lane i valid iff i < min(deg, k)). For deg <= k the offsets are simply
+    0..deg-1 (take-all, CSR order); for deg > k, stratum i covers
+    [floor(deg*i/k), floor(deg*(i+1)/k)) and one uniform point is drawn per
+    stratum — distinct by construction. Stratum boundaries are computed
+    overflow-free in int32 via i*(deg//k) + floor(i*(deg%k)/k) (every
+    intermediate <= deg), valid for k <= 46340.
+    """
+    S = deg.shape[0]
+    i = jnp.arange(k, dtype=jnp.int32)[None, :]
+    degc = deg[:, None]
+    q, r_ = degc // k, degc % k
+    lo = i * q + (i * r_) // k
+    hi = (i + 1) * q + ((i + 1) * r_) // k
+    span = jnp.maximum(hi - lo, 1)
+    jitter = jax.random.randint(key, (S, k), 0, span, dtype=jnp.int32)
+    off = jnp.where(degc <= k, jnp.minimum(i, jnp.maximum(degc - 1, 0)), lo + jitter)
+    sel_mask = i < jnp.minimum(degc, k)
+    return off, sel_mask
+
+
+def rotate_offsets(key, offs, length, k: int):
+    """Rotate per-row offsets by a uniform amount modulo ``length``.
+
+    Makes the stratified picks' marginals exactly k/length (strata alone
+    are non-uniform when length % k != 0). Take-all rows (length <= k)
+    keep CSR order. Overflow-free: offs < length and rot < length, so one
+    conditional subtract replaces the mod.
+    """
+    S = offs.shape[0]
+    lenc = length[:, None]
+    rot = jax.random.randint(key, (S, 1), 0, jnp.maximum(lenc, 1), dtype=jnp.int32)
+    shifted = offs + rot
+    rotated = jnp.where(shifted >= lenc, shifted - lenc, shifted)
+    return jnp.where(lenc <= k, offs, rotated)
 
 
 def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False):
@@ -62,29 +102,10 @@ def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False):
     deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
     deg = jnp.where(valid, deg, 0)
 
-    i = jnp.arange(k, dtype=jnp.int32)[None, :]  # (1, K)
-    degc = deg[:, None]  # (S, 1)
-
-    # --- deg > k path: stratified + rotation ---------------------------
-    # Stratum boundary lo(i) = floor(deg*i/k), computed overflow-free in
-    # int32 via the decomposition i*(deg//k) + floor(i*(deg%k)/k): every
-    # intermediate is <= deg (< 2^31) for fanouts k <= 46340.
-    q, r_ = degc // k, degc % k
-    lo = i * q + (i * r_) // k
-    hi = (i + 1) * q + ((i + 1) * r_) // k
-    span = jnp.maximum(hi - lo, 1)
     kj, kr = jax.random.split(key)
-    jitter = jax.random.randint(kj, (S, k), 0, span, dtype=jnp.int32)
-    rot = jax.random.randint(kr, (S, 1), 0, jnp.maximum(degc, 1), dtype=jnp.int32)
-    # (lo + jitter) < deg and rot < deg, so the sum is < 2*deg: one
-    # conditional subtract replaces the mod without overflow.
-    shifted = lo + jitter + rot
-    off_sampled = jnp.where(shifted >= degc, shifted - degc, shifted)
-
-    # --- deg <= k path: take-all ---------------------------------------
-    take_all = degc <= k
-    off = jnp.where(take_all, i, off_sampled)
-    mask = valid[:, None] & (i < jnp.minimum(degc, k))
+    off_nr, mask_sel = stratified_offsets(kj, deg, k)
+    off = rotate_offsets(kr, off_nr, deg, k)
+    mask = valid[:, None] & mask_sel
 
     epos = base[:, None] + off.astype(base.dtype)
     safe_epos = jnp.where(mask, epos, 0)

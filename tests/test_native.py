@@ -1,0 +1,103 @@
+"""Native host runtime tests: differential against numpy (CSR builder,
+row gather) and validity oracle (reservoir sampler) — the same pattern the
+reference uses for its CPU tier (test_quiver_cpu.cpp:9-75)."""
+
+import numpy as np
+import pytest
+
+from quiver_tpu import native
+from quiver_tpu.core.topology import CSRTopo
+
+pytestmark = pytest.mark.skipif(
+    not native.available, reason="native toolchain unavailable"
+)
+
+
+def test_csr_from_coo_matches_numpy():
+    rng = np.random.default_rng(0)
+    n, e = 200, 2000
+    rows = rng.integers(0, n, e)
+    cols = rng.integers(0, n, e)
+    indptr, indices, eid = native.csr_from_coo(rows, cols, n)
+    # indptr identical to bincount-cumsum
+    expect_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(np.bincount(rows, minlength=n), out=expect_ptr[1:])
+    assert np.array_equal(indptr, expect_ptr)
+    # per-row neighbor multisets match; eid maps slots back to COO
+    for v in range(n):
+        lo, hi = indptr[v], indptr[v + 1]
+        got = sorted(indices[lo:hi].tolist())
+        expect = sorted(cols[rows == v].tolist())
+        assert got == expect
+    assert np.array_equal(rows[eid], np.repeat(np.arange(n), np.diff(indptr)))
+    assert np.array_equal(cols[eid], indices)
+
+
+def test_csr_int32_entry_point():
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 50, 300).astype(np.int32)
+    cols = rng.integers(0, 50, 300).astype(np.int32)
+    indptr, indices, eid = native.csr_from_coo(rows, cols, 50)
+    assert indptr[-1] == 300
+    assert np.array_equal(cols[eid], indices)
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(2)
+    table = rng.normal(size=(500, 64)).astype(np.float32)
+    ids = rng.integers(0, 500, 200)
+    out = native.gather_rows(table, ids)
+    assert np.array_equal(out, table[ids])
+
+
+def test_gather_rows_sentinels():
+    table = np.arange(20, dtype=np.float32).reshape(10, 2)
+    out = native.gather_rows(table, np.array([3, -1, 9, 100]))
+    assert np.array_equal(out[0], table[3])
+    assert np.all(out[1] == 0)
+    assert np.array_equal(out[2], table[9])
+    assert np.all(out[3] == 0)  # out of range -> zero row, not UB
+
+
+def test_gather_rows_dtypes():
+    for dtype in (np.float32, np.float64, np.int32):
+        table = np.arange(24).reshape(6, 4).astype(dtype)
+        out = native.gather_rows(table, np.array([5, 0]))
+        assert np.array_equal(out, table[[5, 0]])
+
+
+def test_native_sampler_validity():
+    rng = np.random.default_rng(3)
+    n, e = 100, 1500
+    rows = rng.integers(0, n, e)
+    cols = rng.integers(0, n, e)
+    indptr, indices, _ = native.csr_from_coo(rows, cols, n)
+    seeds = rng.integers(0, n, 64).astype(np.int32)
+    k = 5
+    out, counts = native.sample_neighbors(indptr, indices, seeds, k, seed=7)
+    for i, s in enumerate(seeds):
+        deg = indptr[s + 1] - indptr[s]
+        assert counts[i] == min(deg, k)
+        row = set(indices[indptr[s]:indptr[s + 1]].tolist())
+        got = out[i][out[i] >= 0]
+        assert len(got) == counts[i]
+        assert set(got.tolist()) <= row
+        if deg > k:
+            # reservoir samples distinct positions
+            assert len(got) == k
+    # padding seed
+    out, counts = native.sample_neighbors(indptr, indices, np.array([-1], np.int32), k)
+    assert counts[0] == 0 and np.all(out[0] == -1)
+
+
+def test_csrtopo_uses_native_builder():
+    rng = np.random.default_rng(4)
+    ei = np.stack([rng.integers(0, 30, 200), rng.integers(0, 30, 200)])
+    t_native = CSRTopo(edge_index=ei, use_native=True)
+    t_numpy = CSRTopo(edge_index=ei, use_native=False)
+    assert np.array_equal(t_native.indptr, t_numpy.indptr)
+    for v in range(30):
+        lo, hi = t_native.indptr[v], t_native.indptr[v + 1]
+        assert sorted(t_native.indices[lo:hi].tolist()) == sorted(
+            t_numpy.indices[lo:hi].tolist()
+        )

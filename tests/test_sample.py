@@ -60,14 +60,16 @@ def test_sample_validity(n, deg, k):
 
 
 def test_sample_take_all_exact():
-    # deg <= k rows must return the full neighborhood in CSR order
+    # deg <= k rows must return the full neighborhood (intra-row order is
+    # unspecified — the native CSR scatter is unordered across threads)
     ei, v = _simple_graph(16, 4)
     topo = CSRTopo(edge_index=ei).to_device()
     seeds = jnp.arange(10, dtype=jnp.int32)
     nbr, counts = sample_layer(topo, seeds, jnp.int32(10), 6, jax.random.PRNGKey(1))
     nbr = np.asarray(nbr)
     for r in range(10):
-        assert np.array_equal(nbr[r, :4], ((np.arange(4) + 1) * 16 + r) % v)
+        expect = sorted((((np.arange(4) + 1) * 16 + r) % v).tolist())
+        assert sorted(nbr[r, :4].tolist()) == expect
         assert np.all(nbr[r, 4:] == -1)
 
 
