@@ -13,8 +13,15 @@ Top-level exports mirror the reference package surface
 
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
 from .core.topology import CSRTopo, DeviceTopology
+from .feature.feature import Feature
+from .feature.shard import ShardedFeature, ShardedTensor
+from .parallel.mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.reorder import reorder_by_degree
+
+# reference name parity: `quiver.p2pCliqueTopo` (utils.py:64-104) is the
+# clique view of the device set — on TPU, the ICI-slice view
+p2pCliqueTopo = MeshTopo
 
 __all__ = [
     "CSRTopo",
@@ -22,6 +29,14 @@ __all__ = [
     "GraphSageSampler",
     "Adj",
     "SampleOutput",
+    "Feature",
+    "ShardedFeature",
+    "ShardedTensor",
+    "MeshTopo",
+    "p2pCliqueTopo",
+    "make_mesh",
+    "init_p2p",
+    "can_device_access_peer",
     "CachePolicy",
     "SampleMode",
     "parse_size_bytes",

@@ -39,9 +39,9 @@ def _index_dtype(max_value: int) -> np.dtype:
 def _build_csr(row, col, node_count: int, use_native: bool):
     """COO -> CSR. Prefers the native linear-time parallel builder
     (native/quiver_host.cpp csr_from_coo); falls back to numpy stable
-    argsort. Intra-row neighbor order is unspecified (the native scatter is
-    unordered across threads); ``eid`` is the authoritative CSR-slot -> COO
-    mapping either way."""
+    argsort. Both are stable (CSR slots within a row follow COO order), so
+    the two paths — and independent builds on different hosts — produce
+    identical indices/eid arrays."""
     if use_native and node_count <= np.iinfo(np.int32).max:
         try:
             from ..native import available, csr_from_coo
@@ -75,6 +75,10 @@ class CSRTopo:
             if edge_index.ndim != 2 or edge_index.shape[0] != 2:
                 raise ValueError(f"edge_index must be (2, E), got {edge_index.shape}")
             row, col = edge_index[0], edge_index[1]
+            if edge_index.size and min(row.min(), col.min()) < 0:
+                # the native builder indexes raw ids; a stray -1 sentinel
+                # must fail loudly here, not corrupt memory there
+                raise ValueError("edge_index must not contain negative node ids")
             node_count = int(max(row.max(initial=-1), col.max(initial=-1)) + 1)
             indptr, indices, eid = _build_csr(row, col, node_count, use_native)
         elif indptr is not None and indices is not None:

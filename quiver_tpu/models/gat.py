@@ -1,0 +1,114 @@
+"""Graph Attention Network over padded Adj blocks.
+
+The reference delegates GAT to PyG (its ogbn-products GAT config is plain
+``torch_geometric.nn.GATConv`` fed by quiver's sampler/feature — BASELINE
+config 4 "attention aggregation, exercises segment-softmax"). quiver-tpu
+ships a TPU-native GATConv: multi-head additive attention with a
+segment-softmax over the padded edge list (-1 sentinel lanes excluded), all
+dense matmuls batched over heads so the MXU sees (E, H*F)-shaped work.
+
+Semantics follow PyG's GATConv (v1, Velickovic et al.):
+  e_ij  = LeakyReLU(a_l . (W h_j) + a_r . (W h_i))
+  alpha = softmax_i(e_ij)   (over j in N(i), per head)
+  h_i'  = concat_heads( sum_j alpha_ij W h_j )   [+ mean over heads if
+          ``concat=False``, as PyG does for the output layer]
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .layers import segment_softmax
+
+__all__ = ["GATConv", "GAT"]
+
+
+class GATConv(nn.Module):
+    """Multi-head graph attention over a padded edge block.
+
+    Args:
+      features: per-head output width F.
+      heads: number of attention heads H.
+      concat: concatenate heads (output H*F) or average them (output F).
+      negative_slope: LeakyReLU slope for attention logits.
+    """
+
+    features: int
+    heads: int = 1
+    concat: bool = True
+    negative_slope: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, edge_index, num_dst: int):
+        src, dst = edge_index[0], edge_index[1]
+        valid = (src >= 0) & (dst >= 0)
+        src_safe = jnp.clip(src, 0)
+        dst_safe = jnp.where(valid, dst, num_dst)  # overflow segment
+
+        H, F = self.heads, self.features
+        # one dense projection for all heads: (N, in) -> (N, H, F)
+        w = nn.Dense(H * F, use_bias=False, name="lin")
+        h_all = w(x).reshape(x.shape[0], H, F)
+        h_dst = h_all[:num_dst]
+
+        a_l = self.param("att_l", nn.initializers.glorot_uniform(), (H, F))
+        a_r = self.param("att_r", nn.initializers.glorot_uniform(), (H, F))
+        # per-node attention halves, then per-edge sum — avoids forming the
+        # (E, H, 2F) concat the naive formulation would need
+        alpha_src = (h_all * a_l).sum(-1)  # (N, H)
+        alpha_dst = (h_dst * a_r).sum(-1)  # (num_dst, H)
+
+        logits = alpha_src[src_safe] + alpha_dst[jnp.clip(dst, 0, num_dst - 1)]
+        logits = nn.leaky_relu(logits, self.negative_slope)  # (E, H)
+        # segment softmax over each destination's edges, all heads at once
+        alpha = segment_softmax(logits, dst_safe, valid, num_dst)  # (E, H)
+
+        msgs = h_all[src_safe] * alpha[:, :, None]  # (E, H, F)
+        msgs = jnp.where(valid[:, None, None], msgs, 0.0)
+        out = jnp.zeros((num_dst + 1, H, F), msgs.dtype).at[dst_safe].add(msgs)
+        out = out[:num_dst]
+
+        bias = self.param(
+            "bias", nn.initializers.zeros, (H * F,) if self.concat else (F,)
+        )
+        if self.concat:
+            return out.reshape(num_dst, H * F) + bias
+        return out.mean(axis=1) + bias
+
+
+class GAT(nn.Module):
+    """Multi-layer GAT consuming sampler output (adjs deepest-first).
+
+    Mirrors the PyG mini-batch GAT recipe: hidden layers concat heads + ELU;
+    the output layer averages heads (concat=False) into ``num_classes``.
+    """
+
+    hidden: int
+    num_classes: int
+    num_layers: int = 2
+    heads: int = 4
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, adjs: Sequence, *, train: bool = False):
+        if len(adjs) != self.num_layers:
+            raise ValueError(
+                f"model has {self.num_layers} layers but got {len(adjs)} adjs; "
+                "sampler sizes and num_layers must match"
+            )
+        for i, adj in enumerate(adjs):
+            num_dst = adj.size[1]
+            last = i == self.num_layers - 1
+            x = GATConv(
+                features=self.num_classes if last else self.hidden,
+                heads=1 if last else self.heads,
+                concat=not last,
+                name=f"conv{i}",
+            )(x, adj.edge_index, num_dst)
+            if not last:
+                x = nn.elu(x)
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.log_softmax(x, axis=-1)

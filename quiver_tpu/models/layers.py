@@ -39,15 +39,18 @@ def segment_mean_aggregate(messages, dst, valid, num_dst: int):
 def segment_softmax(logits, seg, valid, num_seg: int):
     """Numerically-stable softmax over edges grouped by target segment.
 
-    Exercises the same pattern a GAT needs (BASELINE.json config 4:
-    "attention aggregation, exercises segment-softmax").
+    ``logits`` may be (E,) or (E, ...) — trailing dims (e.g. attention
+    heads) are softmaxed independently. Exercises the pattern a GAT needs
+    (BASELINE.json config 4: "attention aggregation, exercises
+    segment-softmax").
     """
+    validb = valid.reshape(valid.shape + (1,) * (logits.ndim - 1))
     seg_safe = jnp.where(valid, seg, num_seg)
     neg = jnp.finfo(logits.dtype).min
-    masked = jnp.where(valid, logits, neg)
+    masked = jnp.where(validb, logits, neg)
     seg_max = jax.ops.segment_max(masked, seg_safe, num_segments=num_seg + 1)
     seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
-    shifted = jnp.where(valid, logits - seg_max[seg_safe], neg)
-    expv = jnp.where(valid, jnp.exp(shifted), 0.0)
+    shifted = jnp.where(validb, logits - seg_max[seg_safe], neg)
+    expv = jnp.where(validb, jnp.exp(shifted), 0.0)
     denom = jax.ops.segment_sum(expv, seg_safe, num_segments=num_seg + 1)
     return expv / jnp.maximum(denom[seg_safe], jnp.finfo(logits.dtype).tiny)

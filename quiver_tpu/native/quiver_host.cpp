@@ -36,11 +36,16 @@ inline int max_threads() {
 #endif
 }
 
-// Counting sort by row. Serial path uses plain increments (atomics cost
-// ~40% when there is no parallelism to buy); parallel path uses relaxed
-// atomics on the histogram and scatter cursors. Intra-row order follows
-// COO order serially and is unspecified under threads (eid is the
-// authoritative slot -> COO mapping).
+// Counting sort by row, deterministic and stable in both paths: CSR slots
+// within a row always follow COO order, so native and numpy-argsort builds
+// produce byte-identical indices/eid — a requirement for multi-host SPMD,
+// where every host builds the "replicated" topology independently and the
+// arrays must agree across hosts.
+//
+// Parallel scheme: atomic relaxed histogram (order-independent), then a
+// scatter where each thread owns a contiguous, edge-count-balanced range of
+// *rows* and scans the full edge list, writing only its rows. Reads are
+// streaming and shared via LLC; writes are disjoint per thread.
 template <typename RowT, typename ColT>
 void csr_from_coo_impl(const RowT* rows, const ColT* cols, int64_t n_edges,
                        int64_t n_nodes, int64_t* indptr, int32_t* indices,
@@ -67,14 +72,37 @@ void csr_from_coo_impl(const RowT* rows, const ColT* cols, int64_t n_edges,
   indptr[0] = 0;
   for (int64_t i = 0; i < n_nodes; ++i)
     indptr[i + 1] = indptr[i] + counts[i].load(std::memory_order_relaxed);
-  std::vector<std::atomic<int64_t>> cursor(n_nodes);
-  for (int64_t i = 0; i < n_nodes; ++i)
-    cursor[i].store(indptr[i], std::memory_order_relaxed);
-#pragma omp parallel for schedule(static)
-  for (int64_t e = 0; e < n_edges; ++e) {
-    int64_t slot = cursor[rows[e]].fetch_add(1, std::memory_order_relaxed);
-    indices[slot] = (int32_t)cols[e];
-    if (eid) eid[slot] = e;
+
+  int T = max_threads();
+  // row-range boundaries balanced by edge count (binary search on indptr)
+  std::vector<int64_t> range(T + 1);
+  range[0] = 0;
+  range[T] = n_nodes;
+  for (int t = 1; t < T; ++t) {
+    int64_t target = n_edges * t / T;
+    const int64_t* p =
+        std::lower_bound(indptr, indptr + n_nodes + 1, target);
+    range[t] = std::max(range[t - 1], (int64_t)(p - indptr));
+  }
+#pragma omp parallel num_threads(T)
+  {
+#ifdef _OPENMP
+    int t = omp_get_thread_num();
+#else
+    int t = 0;
+#endif
+    int64_t lo = range[t], hi = range[t + 1];
+    if (lo < hi) {
+      std::vector<int64_t> cursor(indptr + lo, indptr + hi);
+      for (int64_t e = 0; e < n_edges; ++e) {
+        int64_t r = (int64_t)rows[e];
+        if (r >= lo && r < hi) {
+          int64_t slot = cursor[r - lo]++;
+          indices[slot] = (int32_t)cols[e];
+          if (eid) eid[slot] = e;
+        }
+      }
+    }
   }
 }
 

@@ -47,7 +47,7 @@ def _gather_rows_impl(table, ids, tile: int, interpret: bool):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table stays in HBM
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table stays in HBM
         out_specs=pl.BlockSpec(
             (tile, f), lambda i, ids: (i, 0), memory_space=pltpu.VMEM
         ),

@@ -68,7 +68,7 @@ def _run(indices, start, offs, tile, window, k, interpret):
         num_scalar_prefetch=1,  # start addresses
         grid=(Sp // tile,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),  # indices stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # indices stay in HBM
             pl.BlockSpec((tile, k), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
@@ -101,6 +101,13 @@ def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
     E = topo.indices.shape[0]
     if E < window:
         raise ValueError(f"edge_count {E} < window {window}; use the XLA path")
+    if E - window > jnp.iinfo(jnp.int32).max:
+        # window starts ride scalar-prefetch SMEM as int32; past 2^31 edges
+        # they would wrap (the XLA path keeps indptr dtype and stays exact)
+        raise ValueError(
+            f"edge_count {E} exceeds the int32 windowed-DMA range; "
+            "use the XLA path"
+        )
     if k > window:
         # counts reports min(deg, k); with k > window only `window` lanes
         # could ever be valid and counts would overstate them
@@ -109,8 +116,8 @@ def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
     S = seeds.shape[0]
     valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
     s = jnp.where(valid, seeds, 0)
-    base = topo.indptr[s].astype(jnp.int32)
-    deg = (topo.indptr[s + 1].astype(jnp.int32) - base)
+    base = topo.indptr[s]  # keep indptr dtype: values can exceed int32 ranges
+    deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
     deg = jnp.where(valid, deg, 0)
 
     kr, kj, kw = jax.random.split(key, 3)
@@ -124,10 +131,13 @@ def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
     offs, sel_mask = stratified_offsets(kj, wlen, k)
     offs = rotate_offsets(kw, offs, wlen, k)
 
-    start = jnp.clip(base + r, 0, E - window)  # window never leaves the array
+    # window never leaves the array (computed in indptr dtype, cast only
+    # after the clip bounds it under 2^31 — checked above)
+    start_wide = jnp.clip(base + r.astype(base.dtype), 0, E - window)
     # the clip can shift a tail-of-array row's window left of base+r; the
     # offsets then still land inside the row because offs < wlen <= deg
-    off_base = (base + r) - start  # >= 0 correction after the clip
+    off_base = ((base + r.astype(base.dtype)) - start_wide).astype(jnp.int32)
+    start = start_wide.astype(jnp.int32)
     offs = offs + off_base[:, None]
 
     pad = (-S) % tile

@@ -23,14 +23,16 @@ def test_csr_from_coo_matches_numpy():
     expect_ptr = np.zeros(n + 1, np.int64)
     np.cumsum(np.bincount(rows, minlength=n), out=expect_ptr[1:])
     assert np.array_equal(indptr, expect_ptr)
-    # per-row neighbor multisets match; eid maps slots back to COO
+    # stable: slots within a row follow COO order exactly (not just as a
+    # multiset) — the cross-host determinism guarantee
     for v in range(n):
         lo, hi = indptr[v], indptr[v + 1]
-        got = sorted(indices[lo:hi].tolist())
-        expect = sorted(cols[rows == v].tolist())
-        assert got == expect
+        assert indices[lo:hi].tolist() == cols[rows == v].tolist()
     assert np.array_equal(rows[eid], np.repeat(np.arange(n), np.diff(indptr)))
     assert np.array_equal(cols[eid], indices)
+    # and deterministic across repeated builds
+    indptr2, indices2, eid2 = native.csr_from_coo(rows, cols, n)
+    assert np.array_equal(indices, indices2) and np.array_equal(eid, eid2)
 
 
 def test_csr_int32_entry_point():
@@ -96,8 +98,11 @@ def test_csrtopo_uses_native_builder():
     t_native = CSRTopo(edge_index=ei, use_native=True)
     t_numpy = CSRTopo(edge_index=ei, use_native=False)
     assert np.array_equal(t_native.indptr, t_numpy.indptr)
-    for v in range(30):
-        lo, hi = t_native.indptr[v], t_native.indptr[v + 1]
-        assert sorted(t_native.indices[lo:hi].tolist()) == sorted(
-            t_numpy.indices[lo:hi].tolist()
-        )
+    # both builders are stable, so the arrays are byte-identical
+    assert np.array_equal(t_native.indices, t_numpy.indices)
+
+
+def test_csrtopo_rejects_negative_ids():
+    ei = np.array([[0, 1, -1], [1, 2, 0]])
+    with pytest.raises(ValueError, match="negative"):
+        CSRTopo(edge_index=ei)
