@@ -58,6 +58,31 @@ def _build_csr(row, col, node_count: int, use_native: bool):
     return indptr, np.ascontiguousarray(col[order]), order
 
 
+def _row_prefix_weights(w: np.ndarray, indptr: np.ndarray) -> np.ndarray:
+    """Row-local inclusive prefix sums of CSR-ordered edge weights.
+
+    The device-side weighted sampler inverse-CDF-searches these per row
+    (the TPU analogue of the reference's per-node normalized prefix weights,
+    cuda_random.cu.hpp:160-170). Rows whose total weight is <= 0 get the
+    uniform prefix 1..deg so they degrade to uniform sampling instead of NaN.
+    Computed in float64 (a global cumsum over E edges), emitted float32
+    (row-local magnitudes only).
+    """
+    E = int(w.shape[0])
+    deg = np.diff(indptr).astype(np.int64)
+    starts = np.repeat(indptr[:-1].astype(np.int64), deg)  # row start per edge
+    cw = np.cumsum(w, dtype=np.float64)
+    base = np.where(starts > 0, cw[np.maximum(starts - 1, 0)], 0.0)
+    prefix = cw - base
+    ends = indptr[1:].astype(np.int64) - 1
+    tot = np.where(deg > 0, prefix[np.maximum(ends, 0)], 0.0)
+    bad = np.repeat(tot <= 0, deg)
+    if bad.any():
+        local = np.arange(E, dtype=np.int64) - starts
+        prefix[bad] = (local[bad] + 1).astype(np.float64)
+    return prefix.astype(np.float32)
+
+
 class CSRTopo:
     """CSR graph topology with degree and feature-order bookkeeping.
 
@@ -67,7 +92,7 @@ class CSRTopo:
     """
 
     def __init__(self, edge_index=None, indptr=None, indices=None, eid=None,
-                 use_native: bool = True):
+                 edge_weight=None, use_native: bool = True):
         if edge_index is not None:
             if indptr is not None or indices is not None:
                 raise ValueError("pass either edge_index or indptr/indices, not both")
@@ -110,6 +135,10 @@ class CSRTopo:
         self._indices = indices.astype(_index_dtype(max(node_count - 1, 0)), copy=False)
         self._eid = None if eid is None else eid.astype(_index_dtype(max(edge_count - 1, 0)), copy=False)
         self._feature_order = None  # set by Feature's degree reorder
+        self._edge_weight = None
+        self._cum_weights = None
+        if edge_weight is not None:
+            self.set_edge_weight(edge_weight, coo_order=edge_index is not None)
 
     # -- properties (parity with reference utils.py:150-210) ---------------
 
@@ -139,6 +168,44 @@ class CSRTopo:
             )
         self._feature_order = order
 
+    # -- edge weights (weighted sampling) -----------------------------------
+    # The reference *plumbed* per-edge weights (inverse-CDF ``weight_sample``,
+    # cuda_random.cu.hpp:143-186) but the weighted constructor is commented
+    # out (quiver.cu.hpp:240-272), leaving the path unreachable. Here it is a
+    # real, tested feature.
+
+    def set_edge_weight(self, edge_weight, coo_order: bool = True) -> "CSRTopo":
+        """Attach per-edge weights for weighted neighbor sampling.
+
+        ``coo_order=True`` means weights align with the COO edge order this
+        topology was built from (translated through ``eid``); otherwise they
+        are taken to already be in CSR slot order.
+        """
+        w = _as_numpy(edge_weight).astype(np.float64, copy=False).reshape(-1)
+        if w.shape[0] != self.edge_count:
+            raise ValueError(
+                f"edge_weight must have {self.edge_count} entries, got {w.shape[0]}"
+            )
+        if w.size and w.min() < 0:
+            raise ValueError("edge weights must be non-negative")
+        if coo_order and self._eid is not None:
+            w = w[self._eid]
+        self._edge_weight = w.astype(np.float32)
+        self._cum_weights = _row_prefix_weights(w, self._indptr)
+        return self
+
+    @property
+    def edge_weight(self) -> np.ndarray | None:
+        """Per-edge weights in CSR slot order, or None if unweighted."""
+        return self._edge_weight
+
+    @property
+    def cum_weights(self) -> np.ndarray | None:
+        """Row-local inclusive prefix sums of edge weights (float32, CSR
+        order); rows with non-positive total weight fall back to the uniform
+        prefix 1..deg."""
+        return self._cum_weights
+
     @property
     def degree(self) -> np.ndarray:
         return np.diff(self._indptr)
@@ -160,25 +227,46 @@ class CSRTopo:
 
     # -- device placement ---------------------------------------------------
 
-    def to_device(self, mode: SampleMode | str = SampleMode.HBM, with_eid: bool = False) -> "DeviceTopology":
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM,
+                  with_eid: bool = False, with_weights: bool = False) -> "DeviceTopology":
         """Place the topology for sampling.
 
         HBM mode puts everything in device memory. HOST mode keeps the large
-        ``indices`` (and ``eid``) arrays in pinned host memory where supported
-        — on platforms without a pinned_host memory space it degrades to HBM
-        with a warning-free fallback (CPU tests take this path).
+        ``indices`` (and ``eid``/``cum_weights``) arrays in pinned host memory
+        where supported — on platforms without a pinned_host memory space it
+        degrades to HBM with a warning-free fallback (CPU tests take this
+        path). ``with_weights`` ships the prefix-weight array for weighted
+        sampling (requires ``set_edge_weight`` first).
         """
         mode = SampleMode.parse(mode)
         indptr = jnp.asarray(self._indptr)
         eid = jnp.asarray(self._eid) if (with_eid and self._eid is not None) else None
+        cum_w = None
+        if with_weights:
+            if self._cum_weights is None:
+                raise ValueError(
+                    "weighted sampling requires edge weights; call "
+                    "set_edge_weight() or pass edge_weight= to CSRTopo"
+                )
+            cum_w = self._cum_weights
         host = False
         if mode == SampleMode.HOST:
             indices, host = to_pinned_host(self._indices)
             if eid is not None and host:
                 eid, _ = to_pinned_host(self._eid)
+            if cum_w is not None and host:
+                cum_w, _ = to_pinned_host(cum_w)
+            elif cum_w is not None:
+                cum_w = jnp.asarray(cum_w)
         else:
             indices = jnp.asarray(self._indices)
-        return DeviceTopology(indptr=indptr, indices=indices, eid=eid, host_indices=host)
+            if cum_w is not None:
+                cum_w = jnp.asarray(cum_w)
+        # static iteration bound for the device-side per-row binary search
+        iters = max(int(np.ceil(np.log2(self.max_degree + 1))), 1) if cum_w is not None else 0
+        return DeviceTopology(indptr=indptr, indices=indices, eid=eid,
+                              cum_weights=cum_w, host_indices=host,
+                              search_iters=iters)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -189,11 +277,14 @@ class DeviceTopology:
     pinned host memory (HOST mode) so gathers must stage through host compute.
     """
 
-    def __init__(self, indptr, indices, eid=None, host_indices: bool = False):
+    def __init__(self, indptr, indices, eid=None, cum_weights=None,
+                 host_indices: bool = False, search_iters: int = 0):
         self.indptr = indptr
         self.indices = indices
         self.eid = eid
+        self.cum_weights = cum_weights
         self.host_indices = host_indices
+        self.search_iters = search_iters
 
     @property
     def node_count(self) -> int:
@@ -204,11 +295,11 @@ class DeviceTopology:
         return self.indices.shape[0]
 
     def tree_flatten(self):
-        if self.eid is None:
-            return (self.indptr, self.indices), ("no_eid", self.host_indices)
-        return (self.indptr, self.indices, self.eid), ("eid", self.host_indices)
+        children = (self.indptr, self.indices, self.eid, self.cum_weights)
+        return children, (self.host_indices, self.search_iters)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        eid = children[2] if aux[0] == "eid" else None
-        return cls(children[0], children[1], eid, host_indices=aux[1])
+        indptr, indices, eid, cum_weights = children
+        return cls(indptr, indices, eid, cum_weights,
+                   host_indices=aux[0], search_iters=aux[1])

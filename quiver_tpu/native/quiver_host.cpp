@@ -42,15 +42,19 @@ inline int max_threads() {
 // where every host builds the "replicated" topology independently and the
 // arrays must agree across hosts.
 //
-// Parallel scheme: atomic relaxed histogram (order-independent), then a
-// scatter where each thread owns a contiguous, edge-count-balanced range of
-// *rows* and scans the full edge list, writing only its rows. Reads are
-// streaming and shared via LLC; writes are disjoint per thread.
+// Parallel scheme, O(E + T*N) total work: atomic relaxed histogram for
+// indptr (order-independent), then a chunked stable scatter — edges are
+// split into T contiguous chunks, each thread histograms its own chunk
+// per row, a cross-chunk exclusive scan per row turns the histograms into
+// deterministic per-(chunk,row) cursors, and each thread scatters only its
+// own chunk. Stability holds because chunk order equals COO order. The
+// T*N*4B cursor matrix is capped at ~1GB by shrinking T (T=1 degenerates
+// to the serial single-pass scatter, still O(E)).
 template <typename RowT, typename ColT>
 void csr_from_coo_impl(const RowT* rows, const ColT* cols, int64_t n_edges,
                        int64_t n_nodes, int64_t* indptr, int32_t* indices,
                        int64_t* eid) {
-  if (max_threads() <= 1) {
+  auto serial = [&]() {
     std::vector<int64_t> counts(n_nodes, 0);
     for (int64_t e = 0; e < n_edges; ++e) counts[rows[e]]++;
     indptr[0] = 0;
@@ -61,6 +65,10 @@ void csr_from_coo_impl(const RowT* rows, const ColT* cols, int64_t n_edges,
       indices[slot] = (int32_t)cols[e];
       if (eid) eid[slot] = e;
     }
+  };
+  // uint32 chunk cursors assume per-row degrees < 2^32
+  if (max_threads() <= 1 || n_edges >= (int64_t)UINT32_MAX) {
+    serial();
     return;
   }
   std::vector<std::atomic<int64_t>> counts(n_nodes);
@@ -73,35 +81,50 @@ void csr_from_coo_impl(const RowT* rows, const ColT* cols, int64_t n_edges,
   for (int64_t i = 0; i < n_nodes; ++i)
     indptr[i + 1] = indptr[i] + counts[i].load(std::memory_order_relaxed);
 
+  // cap the T*N cursor matrix at ~1GB
   int T = max_threads();
-  // row-range boundaries balanced by edge count (binary search on indptr)
-  std::vector<int64_t> range(T + 1);
-  range[0] = 0;
-  range[T] = n_nodes;
-  for (int t = 1; t < T; ++t) {
-    int64_t target = n_edges * t / T;
-    const int64_t* p =
-        std::lower_bound(indptr, indptr + n_nodes + 1, target);
-    range[t] = std::max(range[t - 1], (int64_t)(p - indptr));
+  int64_t t_cap = ((int64_t)1 << 30) / (4 * std::max(n_nodes, (int64_t)1));
+  if (t_cap < T) T = (int)std::max(t_cap, (int64_t)1);
+  if (T <= 1) {
+    std::vector<int64_t> cursor(indptr, indptr + n_nodes);
+    for (int64_t e = 0; e < n_edges; ++e) {
+      int64_t slot = cursor[rows[e]]++;
+      indices[slot] = (int32_t)cols[e];
+      if (eid) eid[slot] = e;
+    }
+    return;
   }
-#pragma omp parallel num_threads(T)
-  {
-#ifdef _OPENMP
-    int t = omp_get_thread_num();
-#else
-    int t = 0;
-#endif
-    int64_t lo = range[t], hi = range[t + 1];
-    if (lo < hi) {
-      std::vector<int64_t> cursor(indptr + lo, indptr + hi);
-      for (int64_t e = 0; e < n_edges; ++e) {
-        int64_t r = (int64_t)rows[e];
-        if (r >= lo && r < hi) {
-          int64_t slot = cursor[r - lo]++;
-          indices[slot] = (int32_t)cols[e];
-          if (eid) eid[slot] = e;
-        }
-      }
+
+  // chunk boundaries over the edge list
+  std::vector<int64_t> chunk(T + 1);
+  for (int t = 0; t <= T; ++t) chunk[t] = n_edges * t / T;
+
+  // per-(chunk,row) histogram; c[t*n_nodes + r]
+  std::vector<uint32_t> c((size_t)T * n_nodes, 0);
+#pragma omp parallel for schedule(static) num_threads(T)
+  for (int t = 0; t < T; ++t) {
+    uint32_t* ct = c.data() + (size_t)t * n_nodes;
+    for (int64_t e = chunk[t]; e < chunk[t + 1]; ++e) ct[rows[e]]++;
+  }
+  // exclusive scan across chunks, per row
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n_nodes; ++r) {
+    uint32_t running = 0;
+    for (int t = 0; t < T; ++t) {
+      uint32_t tmp = c[(size_t)t * n_nodes + r];
+      c[(size_t)t * n_nodes + r] = running;
+      running += tmp;
+    }
+  }
+  // stable scatter: thread t owns chunk t and its cursor row
+#pragma omp parallel for schedule(static) num_threads(T)
+  for (int t = 0; t < T; ++t) {
+    uint32_t* ct = c.data() + (size_t)t * n_nodes;
+    for (int64_t e = chunk[t]; e < chunk[t + 1]; ++e) {
+      int64_t r = (int64_t)rows[e];
+      int64_t slot = indptr[r] + (int64_t)(ct[r]++);
+      indices[slot] = (int32_t)cols[e];
+      if (eid) eid[slot] = e;
     }
   }
 }

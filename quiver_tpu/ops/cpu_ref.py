@@ -14,7 +14,12 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["sample_layer_ref", "reindex_layer_ref", "multilayer_ref"]
+__all__ = [
+    "sample_layer_ref",
+    "weighted_sample_ref",
+    "reindex_layer_ref",
+    "multilayer_ref",
+]
 
 
 def sample_layer_ref(indptr, indices, seeds, k, rng=None):
@@ -37,6 +42,34 @@ def sample_layer_ref(indptr, indices, seeds, k, rng=None):
             pick = rng.choice(deg, size=k, replace=False)
             out[r, :k] = indices[lo + pick]
             counts[r] = k
+    return out, counts
+
+
+def weighted_sample_ref(indptr, indices, weights, seeds, k, rng=None):
+    """Weight-proportional sampling oracle (reference ``weight_sample``
+    semantics, cuda_random.cu.hpp:143-186: k independent inverse-CDF draws
+    with replacement; copy-all when deg <= k). Padded to (S, k)."""
+    rng = rng or np.random.default_rng(0)
+    S = len(seeds)
+    out = np.full((S, k), -1, dtype=np.int64)
+    counts = np.zeros(S, dtype=np.int64)
+    for r, s in enumerate(seeds):
+        if s < 0:
+            continue
+        lo, hi = int(indptr[s]), int(indptr[s + 1])
+        deg = hi - lo
+        if deg == 0:
+            continue
+        if deg <= k:
+            out[r, :deg] = indices[lo:hi]
+            counts[r] = deg
+            continue
+        w = np.asarray(weights[lo:hi], dtype=np.float64)
+        tot = w.sum()
+        p = np.full(deg, 1.0 / deg) if tot <= 0 else w / tot
+        pick = rng.choice(deg, size=k, replace=True, p=p)
+        out[r, :k] = indices[lo + pick]
+        counts[r] = k
     return out, counts
 
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_layer", "stratified_offsets", "staged_gather"]
+__all__ = ["sample_layer", "stratified_offsets", "weighted_offsets", "staged_gather"]
 
 
 def stratified_offsets(key, deg, k: int):
@@ -71,7 +71,87 @@ def rotate_offsets(key, offs, length, k: int):
     return jnp.where(lenc <= k, offs, rotated)
 
 
-def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False):
+def _cdf_search(cum_weights, u, base, deg, iters: int):
+    """Vectorized per-row inverse-CDF binary search.
+
+    For each lane (s, j): smallest CSR slot m in row [base_s, base_s+deg_s)
+    with cum_weights[m] >= u[s, j]. ``iters`` >= ceil(log2(max_degree+1))
+    guarantees convergence. Returns row-local offsets (S, k) int32.
+    """
+    S, k = u.shape
+    degc = deg[:, None].astype(base.dtype)
+    basec = base[:, None]
+    # arithmetic masking instead of jnp.where-with-literals: under
+    # compute_on("device_host") every select_n operand must share the host
+    # memory space, and broadcast scalar literals land in device space
+    nonempty = degc > 0
+    lo = jnp.broadcast_to(basec, (S, k))
+    hi = lo + (degc - 1) * nonempty
+    for _ in range(iters):
+        mid = (lo + hi) // 2
+        pm = cum_weights[mid * nonempty]
+        go_right = pm < u
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    return (lo - basec).astype(jnp.int32)
+
+
+def _cdf_search_host(cum_weights, u, base, deg, iters: int):
+    """_cdf_search staged as host compute (HOST mode keeps the prefix array
+    in pinned host memory; only the small u/base/deg blocks transit — the
+    same explicit host/device placement dance as _staged_gather)."""
+    from jax.experimental.compute_on import compute_on
+    from jax.sharding import SingleDeviceSharding
+
+    dev = jax.devices()[0]
+    host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
+    dev_s = SingleDeviceSharding(dev, memory_kind="device")
+    u_h = jax.device_put(u, host_s)
+    base_h = jax.device_put(base, host_s)
+    deg_h = jax.device_put(deg, host_s)
+
+    @compute_on("device_host")
+    def search(cw, uu, bb, dd):
+        return _cdf_search(cw, uu, bb, dd, iters)
+
+    return jax.device_put(search(cum_weights, u_h, base_h, deg_h), dev_s)
+
+
+
+
+def weighted_offsets(key, cum_weights, base, deg, k: int, iters: int,
+                     host: bool = False):
+    """k weight-proportional draws per row via inverse-CDF binary search.
+
+    The TPU rebuild of the reference's ``weight_sample``
+    (cuda_random.cu.hpp:143-186): each of the k slots draws independently
+    (with replacement, matching the reference's semantics) from the row's
+    categorical distribution over the row-local inclusive prefix
+    ``cum_weights``. Rows with ``deg <= k`` take all neighbors in CSR order
+    instead — the reference's ``safe_sample`` copy-all branch
+    (cuda_random.cu.hpp:196-205). With ``host=True`` the search runs as host
+    compute against the host-resident prefix array.
+
+    Returns (offsets (S, k) int32 row-local, sel_mask (S, k)).
+    """
+    S = deg.shape[0]
+    degc = deg[:, None]
+    end = jnp.maximum(base + deg.astype(base.dtype) - 1, 0)
+    tot = staged_gather(cum_weights, end, host)
+    tot = jnp.where(deg > 0, tot, 1.0)
+    u = jax.random.uniform(key, (S, k), dtype=cum_weights.dtype) * tot[:, None]
+    if host:
+        off = _cdf_search_host_call(cum_weights, u, base, deg, iters)
+    else:
+        off = _cdf_search(cum_weights, u, base, deg, iters)
+    i = jnp.arange(k, dtype=jnp.int32)[None, :]
+    off = jnp.where(degc <= k, jnp.minimum(i, jnp.maximum(degc - 1, 0)), off)
+    sel_mask = i < jnp.minimum(degc, k)
+    return off, sel_mask
+
+
+def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False,
+                 weighted: bool = False):
     """Sample up to ``k`` neighbors for each valid seed.
 
     Args:
@@ -102,9 +182,20 @@ def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False):
     deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
     deg = jnp.where(valid, deg, 0)
 
-    kj, kr = jax.random.split(key)
-    off_nr, mask_sel = stratified_offsets(kj, deg, k)
-    off = rotate_offsets(kr, off_nr, deg, k)
+    if weighted:
+        if topo.cum_weights is None:
+            raise ValueError(
+                "weighted sampling needs topo.cum_weights; build the "
+                "DeviceTopology with to_device(with_weights=True)"
+            )
+        off, mask_sel = weighted_offsets(
+            key, topo.cum_weights, base, deg, k, topo.search_iters,
+            host=topo.host_indices,
+        )
+    else:
+        kj, kr = jax.random.split(key)
+        off_nr, mask_sel = stratified_offsets(kj, deg, k)
+        off = rotate_offsets(kr, off_nr, deg, k)
     mask = valid[:, None] & mask_sel
 
     epos = base[:, None] + off.astype(base.dtype)
@@ -127,6 +218,30 @@ def _gather_indices(topo, epos):
     return staged_gather(topo.indices, epos, getattr(topo, "host_indices", False))
 
 
+def staged_host_call(fn, static_argnums=()):
+    """Wrap a host-compute ``fn`` with the traced-vs-eager dispatch.
+
+    Traced calls run ``fn`` inline (its compute_on block composes into the
+    enclosing jit). Eager calls go through a cached jit wrapper, because
+    eager compute_on leaves a host memory space in the result aval that
+    later eager ops reject — the jit boundary re-anchors the result in
+    device space.
+    """
+    static = set(static_argnums)
+    jitted = jax.jit(fn, static_argnums=tuple(static_argnums))
+
+    def call(*args):
+        dyn = [a for i, a in enumerate(args) if i not in static]
+        if any(
+            isinstance(x, jax.core.Tracer)
+            for x in jax.tree_util.tree_leaves(dyn)
+        ):
+            return fn(*args)
+        return jitted(*args)
+
+    return call
+
+
 def staged_gather(table, idx, host: bool, mesh=None):
     """Gather rows of ``table``, staging through host memory when ``host``.
 
@@ -140,12 +255,7 @@ def staged_gather(table, idx, host: bool, mesh=None):
     """
     if not host:
         return table[idx]
-    if isinstance(idx, jax.core.Tracer):
-        return _staged_gather(table, idx, mesh)
-    # eager call: compute_on leaves a host memory space in the result aval
-    # that later eager ops reject, so jit the whole stage (the jit boundary
-    # re-anchors the result in device space)
-    return _staged_gather_jit(table, idx, mesh)
+    return _staged_gather_call(table, idx, mesh)
 
 
 def _staged_gather(table, idx, mesh=None):
@@ -172,6 +282,7 @@ def _staged_gather(table, idx, mesh=None):
     return jax.device_put(out_h, dev_s)
 
 
-# module-level wrapper so repeated eager calls hit the jit dispatch fastpath
-# (Mesh is hashable, so it can ride as a static arg)
-_staged_gather_jit = jax.jit(_staged_gather, static_argnums=2)
+# module-level wrappers so repeated eager calls hit the jit dispatch fastpath
+# (Mesh and iters are hashable, so they ride as static args)
+_staged_gather_call = staged_host_call(_staged_gather, static_argnums=(2,))
+_cdf_search_host_call = staged_host_call(_cdf_search_host, static_argnums=(4,))

@@ -79,7 +79,7 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps):
+def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
     """The multi-layer sample+reindex loop (jit- and shard_map-composable).
 
     One trace covers all layers — the fused analogue of the reference's
@@ -93,7 +93,7 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps):
     total_overflow = jnp.zeros((), jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
-        nbr, _ = sample_layer(topo, cur, cur_n, k, sub)
+        nbr, _ = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
         frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
         S = cur.shape[0]
         row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
@@ -130,6 +130,7 @@ class GraphSageSampler:
         seed_capacity: int | None = None,
         frontier_caps: Sequence[int] | None = None,
         seed: int = 0,
+        weighted: bool = False,
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -137,7 +138,13 @@ class GraphSageSampler:
         self.sizes = tuple(int(k) if k != -1 else max_deg for k in sizes)
         if any(k < 1 for k in self.sizes):
             raise ValueError(f"fanouts must be >= 1 or -1, got {sizes}")
-        self.topo = csr_topo.to_device(self.mode)
+        self.weighted = bool(weighted)
+        if self.weighted and csr_topo.cum_weights is None:
+            raise ValueError(
+                "weighted=True requires edge weights; call "
+                "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
+            )
+        self.topo = csr_topo.to_device(self.mode, with_weights=self.weighted)
         self._seed_capacity = seed_capacity
         if frontier_caps is not None:
             frontier_caps = tuple(int(c) for c in frontier_caps)
@@ -175,10 +182,12 @@ class GraphSageSampler:
             return self._compiled_cache[seed_cap]
         caps = self._caps_for(seed_cap)
         sizes = self.sizes
+        weighted = self.weighted
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
-            return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps)
+            return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
+                                     weighted=weighted)
 
         self._compiled_cache[seed_cap] = (run, caps)
         return run, caps
