@@ -12,10 +12,18 @@ Top-level exports mirror the reference package surface
 """
 
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
+from .core.hetero import HeteroCSRTopo, RelCSR
 from .core.topology import CSRTopo, DeviceTopology
-from .feature.feature import Feature
+from .feature.feature import Feature, HeteroFeature
 from .feature.shard import ShardedFeature, ShardedTensor
 from .parallel.mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
+from .sampling.hetero import HeteroGraphSampler, HeteroSampleOutput
+from .sampling.saint import (
+    SAINTEdgeSampler,
+    SAINTNodeSampler,
+    SAINTRandomWalkSampler,
+    saint_subgraph,
+)
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.reorder import reorder_by_degree
 
@@ -26,10 +34,19 @@ p2pCliqueTopo = MeshTopo
 __all__ = [
     "CSRTopo",
     "DeviceTopology",
+    "HeteroCSRTopo",
+    "RelCSR",
     "GraphSageSampler",
+    "HeteroGraphSampler",
+    "HeteroSampleOutput",
+    "SAINTNodeSampler",
+    "SAINTEdgeSampler",
+    "SAINTRandomWalkSampler",
+    "saint_subgraph",
     "Adj",
     "SampleOutput",
     "Feature",
+    "HeteroFeature",
     "ShardedFeature",
     "ShardedTensor",
     "MeshTopo",

@@ -1,0 +1,156 @@
+"""Heterogeneous graph topology (typed nodes, typed relations).
+
+The reference has no heterogeneous support — its roadmap's R-GCN/MAG240M
+configs (BASELINE.json config 5) imply it. quiver-tpu makes it first-class:
+a ``HeteroCSRTopo`` holds one rectangular CSR per canonical relation
+``(src_type, rel_name, dst_type)``, stored as *incoming* adjacency
+(row = destination node, columns = source neighbors), because sampling
+expands from seed/destination nodes toward message sources — the same
+direction PyG's NeighborSampler walks.
+
+Each relation's CSR reuses the homogeneous machinery (native linear-time
+builder, DeviceTopology placement, padded sampling ops) — a relation is just
+a rectangular graph whose rows live in the dst-type id space and whose
+column values live in the src-type id space.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .config import SampleMode
+from .memory import to_pinned_host
+from .topology import DeviceTopology, _as_numpy, _build_csr
+
+__all__ = ["RelCSR", "HeteroCSRTopo"]
+
+EdgeType = tuple  # (src_type, rel_name, dst_type)
+
+
+class RelCSR:
+    """Rectangular CSR for one relation: rows = dst nodes, cols = src nodes.
+
+    Unlike CSRTopo, column values index a *different* (src-type) id space,
+    so the square-graph validation does not apply; ``src_node_count`` bounds
+    them instead.
+    """
+
+    def __init__(self, indptr, indices, src_node_count: int, eid=None):
+        self._indptr = indptr.astype(np.int64, copy=False)
+        self._indices = indices
+        self._eid = eid
+        self.src_node_count = int(src_node_count)
+        if indices.size and int(indices.max()) >= src_node_count:
+            raise ValueError(
+                f"relation references src node {int(indices.max())} but the "
+                f"src type only has {src_node_count} nodes"
+            )
+
+    @classmethod
+    def from_edge_index(cls, edge_index, num_dst: int, num_src: int,
+                        use_native: bool = True) -> "RelCSR":
+        """Build from (2, E) [src_ids, dst_ids] COO (PyG convention)."""
+        edge_index = _as_numpy(edge_index)
+        if edge_index.ndim != 2 or edge_index.shape[0] != 2:
+            raise ValueError(f"edge_index must be (2, E), got {edge_index.shape}")
+        src, dst = edge_index[0], edge_index[1]
+        if edge_index.size:
+            if src.min() < 0 or dst.min() < 0:
+                raise ValueError("edge_index must not contain negative node ids")
+            if int(dst.max()) >= num_dst:
+                raise ValueError(
+                    f"dst id {int(dst.max())} out of range for {num_dst} dst nodes"
+                )
+        # incoming CSR: row = dst, col = src. The native builder stores
+        # column ids as int32, so it is only safe when the SRC id space fits
+        # (the square-topology gate checks rows only).
+        use_native = use_native and num_src <= np.iinfo(np.int32).max
+        indptr, indices, eid = _build_csr(dst, src, num_dst, use_native)
+        return cls(indptr, indices, num_src, eid)
+
+    @property
+    def indptr(self) -> np.ndarray:
+        return self._indptr
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self._indices
+
+    @property
+    def node_count(self) -> int:
+        """Destination-side node count (CSR row count)."""
+        return int(self._indptr.shape[0] - 1)
+
+    @property
+    def edge_count(self) -> int:
+        return int(self._indptr[-1])
+
+    @property
+    def degree(self) -> np.ndarray:
+        """In-degree of each dst node under this relation."""
+        return np.diff(self._indptr)
+
+    @property
+    def max_degree(self) -> int:
+        return int(self.degree.max(initial=0))
+
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM) -> DeviceTopology:
+        mode = SampleMode.parse(mode)
+        indptr = jnp.asarray(self._indptr)
+        host = False
+        if mode == SampleMode.HOST:
+            indices, host = to_pinned_host(self._indices)
+        else:
+            indices = jnp.asarray(self._indices)
+        return DeviceTopology(indptr=indptr, indices=indices, host_indices=host)
+
+
+class HeteroCSRTopo:
+    """Typed multi-relation graph container.
+
+    Args:
+      num_nodes: {node_type: count}.
+      edge_index_dict: {(src_type, rel_name, dst_type): (2, E) [src, dst]}.
+
+    The per-relation CSRs are incoming (dst -> src neighbors); a sampler
+    seeded with dst-type nodes draws the sources that message them.
+    """
+
+    def __init__(self, num_nodes: dict, edge_index_dict: dict,
+                 use_native: bool = True):
+        self.num_nodes = {str(t): int(n) for t, n in num_nodes.items()}
+        self.relations: dict[EdgeType, RelCSR] = {}
+        for etype, ei in edge_index_dict.items():
+            if len(etype) != 3:
+                raise ValueError(
+                    f"edge type must be (src_type, rel, dst_type), got {etype!r}"
+                )
+            s, r, d = etype
+            if s not in self.num_nodes or d not in self.num_nodes:
+                raise ValueError(f"unknown node type in relation {etype!r}")
+            self.relations[(s, r, d)] = RelCSR.from_edge_index(
+                ei, self.num_nodes[d], self.num_nodes[s], use_native
+            )
+
+    @property
+    def node_types(self) -> list:
+        return list(self.num_nodes)
+
+    @property
+    def edge_types(self) -> list:
+        return list(self.relations)
+
+    def rels_into(self, dst_type: str) -> list:
+        """Relations whose destination is ``dst_type`` (sampling fan-in)."""
+        return [et for et in self.relations if et[2] == dst_type]
+
+    def __repr__(self):
+        return (
+            f"HeteroCSRTopo(nodes={self.num_nodes}, "
+            f"relations={[f'{s}-{r}->{d}' for s, r, d in self.relations]})"
+        )
+
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM) -> dict:
+        return {et: rel.to_device(mode) for et, rel in self.relations.items()}

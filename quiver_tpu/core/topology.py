@@ -186,8 +186,9 @@ class CSRTopo:
             raise ValueError(
                 f"edge_weight must have {self.edge_count} entries, got {w.shape[0]}"
             )
-        if w.size and w.min() < 0:
-            raise ValueError("edge weights must be non-negative")
+        if w.size and not (np.isfinite(w).all() and w.min() >= 0):
+            # NaN is < 0-blind and would silently degenerate the CDF search
+            raise ValueError("edge weights must be finite and non-negative")
         if coo_order and self._eid is not None:
             w = w[self._eid]
         self._edge_weight = w.astype(np.float32)

@@ -37,7 +37,7 @@ from ..core.topology import CSRTopo
 from ..ops.sample import staged_gather
 from ..utils.reorder import reorder_by_degree
 
-__all__ = ["Feature", "tiered_lookup"]
+__all__ = ["Feature", "HeteroFeature", "tiered_lookup"]
 
 
 def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather):
@@ -205,3 +205,28 @@ class Feature:
     @classmethod
     def lazy_from_ipc_handle(cls, handle):
         return handle
+
+
+class HeteroFeature:
+    """Per-node-type feature tables for heterogeneous graphs.
+
+    A thin dict-of-Feature: ``__getitem__`` takes the sampler's ``n_id``
+    dict and returns {type: rows} — each type's table keeps its own tiering
+    policy (hot/cold budget, reorder) independently.
+    """
+
+    def __init__(self, features: dict):
+        self.features = dict(features)
+
+    @classmethod
+    def from_cpu_tensors(cls, tensors: dict, **feature_kwargs) -> "HeteroFeature":
+        return cls({
+            t: Feature(**feature_kwargs).from_cpu_tensor(arr)
+            for t, arr in tensors.items()
+        })
+
+    def __getitem__(self, n_id_dict: dict) -> dict:
+        return {t: self.features[t][ids] for t, ids in n_id_dict.items()}
+
+    def size(self, node_type: str, dim: int) -> int:
+        return self.features[node_type].size(dim)

@@ -170,7 +170,10 @@ class GraphSageSampler:
         cur = seed_cap
         n = self.csr_topo.node_count
         for k in self.sizes:
-            cur = min(cur * (k + 1), n)
+            # clamp growth at node_count but never below the previous cap:
+            # forced (seeds-first) lanes keep duplicate seeds as distinct
+            # slots, so each frontier must hold the whole previous one
+            cur = max(min(cur * (k + 1), n), cur)
             cur = _round_up(cur, 8)
             caps.append(cur)
         return tuple(caps)

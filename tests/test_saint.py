@@ -1,0 +1,132 @@
+"""GraphSAINT sampler tests (reference planned qv.saint_subgraph but never
+landed it, SURVEY §2.5 — here it must actually work).
+
+Oracle: numpy induced-subgraph construction.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import CSRTopo
+from quiver_tpu.sampling.saint import (
+    SAINTEdgeSampler,
+    SAINTNodeSampler,
+    SAINTRandomWalkSampler,
+    estimate_saint_norm,
+    random_walk,
+    saint_subgraph,
+)
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+
+def _induced_edges_ref(topo, nodes):
+    """All (u, v) with u, v in nodes and v in N(u), as a set of global pairs."""
+    ns = set(int(x) for x in nodes if x >= 0)
+    out = set()
+    for u in ns:
+        for v in topo.indices[topo.indptr[u]:topo.indptr[u + 1]]:
+            if int(v) in ns:
+                out.add((u, int(v)))
+    return out
+
+
+def test_saint_subgraph_matches_oracle():
+    ei = generate_pareto_graph(300, 6.0, seed=0)
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device()
+    rng = np.random.default_rng(1)
+    nodes = np.unique(rng.integers(0, 300, 80)).astype(np.int32)
+    C = 96
+    padded = np.full(C, -1, np.int32)
+    padded[: len(nodes)] = nodes
+
+    sub = saint_subgraph(dev, jnp.asarray(padded), jnp.int32(len(nodes)),
+                         deg_cap=topo.max_degree)
+    src, dst = np.asarray(sub.edge_index)
+    nid = np.asarray(sub.node_id)
+    got = {(int(nid[s]), int(nid[d])) for s, d in zip(src, dst) if s >= 0}
+    expect = _induced_edges_ref(topo, nodes)
+    assert got == expect
+    assert int(sub.num_nodes) == len(nodes)
+    assert int(sub.num_edges) == len(expect)
+
+
+def test_saint_subgraph_deg_cap_truncates():
+    # star: node 0 -> 1..20
+    ei = np.stack([np.zeros(20, np.int64), np.arange(1, 21)])
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device()
+    padded = np.full(32, -1, np.int32)
+    padded[:21] = np.arange(21)
+    sub = saint_subgraph(dev, jnp.asarray(padded), jnp.int32(21), deg_cap=5)
+    # only the first 5 CSR-order edges of node 0 survive the window
+    assert int(sub.num_edges) == 5
+
+
+def test_node_sampler_end_to_end():
+    ei = generate_pareto_graph(500, 8.0, seed=2)
+    topo = CSRTopo(edge_index=ei)
+    s = SAINTNodeSampler(topo, budget=64, seed=0)
+    sub1 = s.sample()
+    sub2 = s.sample()
+    assert 0 < int(sub1.num_nodes) <= 64
+    # different draws
+    assert not np.array_equal(np.asarray(sub1.node_id), np.asarray(sub2.node_id))
+    # all emitted edges are real graph edges
+    src, dst = np.asarray(sub1.edge_index)
+    nid = np.asarray(sub1.node_id)
+    for sL, dL in zip(src, dst):
+        if sL >= 0:
+            u, v = int(nid[sL]), int(nid[dL])
+            assert v in topo.indices[topo.indptr[u]:topo.indptr[u + 1]]
+
+
+def test_edge_sampler_endpoints_present():
+    ei = generate_pareto_graph(400, 5.0, seed=3)
+    topo = CSRTopo(edge_index=ei)
+    s = SAINTEdgeSampler(topo, budget=32, seed=1)
+    sub = s.sample()
+    assert int(sub.num_nodes) > 0
+    assert int(sub.num_nodes) <= 64  # 2 * budget
+
+
+def test_random_walk_validity():
+    ei = generate_pareto_graph(300, 6.0, seed=4)
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device()
+    starts = jnp.asarray(np.arange(16, dtype=np.int32))
+    walks = np.asarray(random_walk(dev, starts, 4, jax.random.PRNGKey(0)))
+    assert walks.shape == (16, 5)
+    indptr, indices = topo.indptr, topo.indices
+    for r in range(16):
+        assert walks[r, 0] == r
+        for t in range(1, 5):
+            u, v = int(walks[r, t - 1]), int(walks[r, t])
+            # either a real step or a dead-end self-stay
+            assert v == u or v in indices[indptr[u]:indptr[u + 1]]
+
+
+def test_rw_sampler_end_to_end():
+    ei = generate_pareto_graph(400, 6.0, seed=5)
+    topo = CSRTopo(edge_index=ei)
+    s = SAINTRandomWalkSampler(topo, roots=8, walk_length=3, seed=2)
+    sub = s.sample()
+    assert 0 < int(sub.num_nodes) <= 8 * 4
+
+
+def test_estimate_saint_norm():
+    ei = generate_pareto_graph(200, 6.0, seed=6)
+    topo = CSRTopo(edge_index=ei)
+    s = SAINTNodeSampler(topo, budget=50, seed=3)
+    norm, counts = estimate_saint_norm(s, num_iters=20)
+    seen = counts > 0
+    assert seen.any()
+    assert (norm[~seen] == 0).all()
+    # mean-1 scaling over appearing nodes
+    np.testing.assert_allclose(norm[seen].mean(), 1.0, rtol=1e-5)
+    # high-degree nodes appear more often => smaller norm on average
+    deg = topo.degree
+    hi, lo = norm[seen & (deg > np.median(deg))], norm[seen & (deg <= np.median(deg))]
+    if len(hi) and len(lo):
+        assert hi.mean() < lo.mean()

@@ -131,3 +131,19 @@ def test_host_mode_matches_hbm_mode():
     b, cb = sample_layer(host, seeds, jnp.int32(64), 4, key)
     assert np.array_equal(np.asarray(a), np.asarray(b))
     assert np.array_equal(np.asarray(ca), np.asarray(cb))
+
+
+def test_duplicate_seeds_exceeding_node_count_keep_capacity():
+    # regression: caps were clamped to node_count, dropping forced duplicate
+    # seed lanes when batch > number of nodes
+    from quiver_tpu import GraphSageSampler
+
+    ei = np.stack([np.arange(10), (np.arange(10) + 1) % 10])
+    topo = CSRTopo(edge_index=ei)
+    sampler = GraphSageSampler(topo, [2], seed_capacity=64)
+    seeds = np.zeros(50, dtype=np.int64)
+    out = sampler.sample(seeds)
+    nid = np.asarray(out.n_id)
+    assert nid.shape[0] >= 50
+    assert (nid[:50] == 0).all()
+    assert int(out.overflow) == 0
