@@ -1,0 +1,91 @@
+"""Feature-collection throughput (GB/s) benchmark.
+
+Methodology: GB/s = Σ gathered bytes / synchronized wall time, the
+reference's benchmarks/feature/bench_feature.py:35-46. Ids are drawn
+degree-skewed (high-degree nodes proportionally more often), matching what a
+neighbor sampler actually requests — this is exactly the access pattern the
+degree-ordered hot tier exploits (docs/Introduction_en.md:73-119).
+
+Baseline: 14.82 GB/s = reference 1-GPU, ogbn-products, 20% cache, remainder
+served over UVA from host memory (docs/Introduction_en.md:95).
+
+Policies: ``replicate`` = hot tier replicated per device + pinned-host cold
+tier (reference device_replicate); ``shard`` = hot tier sharded over the
+mesh's feature axis with ICI-collective gathers (reference
+p2p_clique_replicate; needs >1 device to mean anything).
+"""
+
+import time
+
+import numpy as np
+
+from benchmarks.common import base_parser, build_graph, emit, log
+
+BASELINE_GBPS = 14.82
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--feature-dim", type=int, default=100)  # products: 100 floats
+    p.add_argument("--cache-ratio", type=float, default=0.2)
+    p.add_argument("--policy", default="replicate", choices=["replicate", "shard"])
+    p.add_argument("--gather-batch", type=int, default=65536)
+    p.set_defaults(iters=50, warmup=5)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import Feature, ShardedFeature
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    topo = build_graph(args)
+    n, f = topo.node_count, args.feature_dim
+    feat = np.random.default_rng(args.seed).normal(size=(n, f)).astype(np.float32)
+    budget = int(args.cache_ratio * n) * f * 4
+
+    if args.policy == "replicate":
+        store = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(feat)
+    else:
+        mesh = make_mesh(feature=len(jax.devices()))
+        store = ShardedFeature(
+            mesh, device_cache_size=budget // len(jax.devices()), csr_topo=topo
+        ).from_cpu_tensor(feat)
+    del feat
+
+    # degree-skewed id stream: P(node) ∝ degree — the sampler's access law
+    rng = np.random.default_rng(args.seed + 1)
+    deg = topo.degree.astype(np.float64)
+    prob = deg / deg.sum()
+    batches = [
+        rng.choice(n, size=args.gather_batch, p=prob).astype(np.int32)
+        for _ in range(min(args.iters, 8))  # reuse id sets; drawing is slow
+    ]
+
+    t0 = time.time()
+    for i in range(args.warmup):
+        res = store[jnp.asarray(batches[i % len(batches)])]
+    jax.block_until_ready(res)
+    log(f"warmup+compile: {time.time()-t0:.1f}s; hot ratio {store.cache_ratio:.2f}")
+
+    total_bytes = 0
+    t0 = time.time()
+    for i in range(args.iters):
+        res = store[jnp.asarray(batches[i % len(batches)])]
+        total_bytes += res.size * res.dtype.itemsize
+    jax.block_until_ready(res)
+    dt = time.time() - t0
+
+    emit(
+        "feature-collection-GBps/chip",
+        total_bytes / dt / 1e9,
+        "GB/s",
+        BASELINE_GBPS,
+        policy=args.policy,
+        cache_ratio=args.cache_ratio,
+        gather_batch=args.gather_batch,
+    )
+
+
+if __name__ == "__main__":
+    main()

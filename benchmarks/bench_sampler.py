@@ -1,0 +1,68 @@
+"""Sampling throughput (SEPS) benchmark — both topology placements.
+
+Methodology: SEPS = Σ valid sampled edges / synchronized wall time, the
+reference's benchmarks/sample/bench_sampler.py:33-43. Padded lanes are NOT
+counted (BASELINE.md honesty rule, SURVEY §7.4.6). Modes:
+
+* ``HBM`` — topology in device HBM (reference "GPU" mode).
+* ``HOST`` — topology in pinned host memory with staged windows (reference
+  "UVA" mode, sage_sampler.py:25-27); the beyond-HBM placement.
+
+Baseline: 34.29M SEPS = reference 1-GPU UVA on ogbn-products [15,10,5]
+(docs/Introduction_en.md:41).
+"""
+
+import time
+
+import numpy as np
+
+from benchmarks.common import base_parser, build_graph, emit, log
+
+BASELINE_UVA_SEPS = 34.29e6
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
+    p.add_argument("--mode", default="HBM", choices=["HBM", "HOST", "GPU", "UVA"])
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import GraphSageSampler
+
+    topo = build_graph(args)
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode=args.mode, seed_capacity=args.batch, seed=args.seed
+    )
+    rng = np.random.default_rng(args.seed)
+
+    t0 = time.time()
+    for _ in range(args.warmup):
+        out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
+    jax.block_until_ready(out.n_id)
+    log(f"warmup+compile: {time.time()-t0:.1f}s")
+
+    total_edges = 0
+    t0 = time.time()
+    for _ in range(args.iters):
+        out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
+        for adj in out.adjs:
+            total_edges += int(jnp.sum(adj.edge_index[0] >= 0))
+    jax.block_until_ready(out.n_id)
+    dt = time.time() - t0
+
+    emit(
+        "sampled-edges/sec/chip",
+        total_edges / dt,
+        "SEPS",
+        BASELINE_UVA_SEPS,
+        mode=args.mode,
+        fanout=args.fanout,
+        batch=args.batch,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Shared plumbing for the benchmark harnesses.
+
+Mirrors the reference's benchmark conventions (SURVEY §6): dataset-free
+synthetic power-law graphs (benchmarks/generated_graph/gen_graph.py),
+synchronized timing, and the canonical metrics — SEPS for sampling
+(benchmarks/sample/bench_sampler.py:33-43), GB/s for feature collection
+(benchmarks/feature/bench_feature.py:35-46), trimmed-mean iteration time for
+end-to-end epochs (benchmarks/ogbn-papers100M/dist_sampling_ogb_paper100M_quiver.py:159-165).
+
+Every script prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", ...extras}`` — the same schema
+as the repo-root ``bench.py`` headline benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+# ogbn-products scale: 2.45M nodes, 123.7M edges (docs/Introduction_en.md)
+PRODUCTS_NODES = 2_450_000
+PRODUCTS_AVG_DEG = 50.5
+PRODUCTS_TRAIN_NODES = 196_615
+
+
+def base_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--nodes", type=int, default=PRODUCTS_NODES)
+    p.add_argument("--avg-degree", type=float, default=PRODUCTS_AVG_DEG)
+    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def build_graph(args):
+    """Synthetic products-scale power-law CSRTopo (+ build-time report)."""
+    import jax
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+    t0 = time.time()
+    ei = generate_pareto_graph(args.nodes, args.avg_degree, seed=args.seed)
+    topo = CSRTopo(edge_index=ei)
+    del ei
+    log(
+        f"graph: {topo.node_count} nodes, {topo.edge_count} edges "
+        f"({time.time()-t0:.1f}s build); device={jax.devices()[0]}"
+    )
+    return topo
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+def emit(metric: str, value: float, unit: str, baseline: float | None, **extras):
+    """Print the one-line JSON result. ``vs_baseline`` > 1 means better than
+    the reference (for time metrics pass baseline/value via ``invert``)."""
+    rec = {
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": unit,
+        "vs_baseline": None if baseline is None else round(value / baseline, 3),
+    }
+    rec.update(extras)
+    print(json.dumps(rec))
+    return rec

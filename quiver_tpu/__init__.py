@@ -17,6 +17,7 @@ from .core.topology import CSRTopo, DeviceTopology
 from .feature.feature import Feature, HeteroFeature
 from .feature.shard import ShardedFeature, ShardedTensor
 from .parallel.mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
+from .parallel.pipeline import Batch, Prefetcher
 from .sampling.hetero import HeteroGraphSampler, HeteroSampleOutput
 from .sampling.saint import (
     SAINTEdgeSampler,
@@ -25,7 +26,10 @@ from .sampling.saint import (
     saint_subgraph,
 )
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
+from .utils.checkpoint import Checkpointer
+from .utils.debug import show_tensor_info, tensor_info
 from .utils.reorder import reorder_by_degree
+from .utils.trace import Timer, enable_trace, get_logger, trace_scope
 
 # reference name parity: `quiver.p2pCliqueTopo` (utils.py:64-104) is the
 # clique view of the device set — on TPU, the ICI-slice view
@@ -51,6 +55,8 @@ __all__ = [
     "ShardedTensor",
     "MeshTopo",
     "p2pCliqueTopo",
+    "Batch",
+    "Prefetcher",
     "make_mesh",
     "init_p2p",
     "can_device_access_peer",
@@ -58,6 +64,13 @@ __all__ = [
     "SampleMode",
     "parse_size_bytes",
     "reorder_by_degree",
+    "show_tensor_info",
+    "tensor_info",
+    "Checkpointer",
+    "Timer",
+    "trace_scope",
+    "enable_trace",
+    "get_logger",
 ]
 
 __version__ = "0.1.0"

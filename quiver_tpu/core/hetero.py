@@ -127,7 +127,7 @@ class HeteroCSRTopo:
                 raise ValueError(
                     f"edge type must be (src_type, rel, dst_type), got {etype!r}"
                 )
-            s, r, d = etype
+            s, r, d = (str(t) for t in etype)
             if s not in self.num_nodes or d not in self.num_nodes:
                 raise ValueError(f"unknown node type in relation {etype!r}")
             self.relations[(s, r, d)] = RelCSR.from_edge_index(

@@ -36,6 +36,7 @@ from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
 from ..ops.sample import staged_gather
 from ..utils.reorder import reorder_by_degree
+from ..utils.trace import get_logger, trace_scope
 
 __all__ = ["Feature", "HeteroFeature", "tiered_lookup"]
 
@@ -127,6 +128,16 @@ class Feature:
             self.hot = jnp.asarray(tensor[:hot_rows])
         if hot_rows < n:
             self.cold, self._cold_is_host = to_pinned_host(tensor[hot_rows:])
+        # placement report (the reference's LOG>>> cache-% print, feature.py:109-111)
+        get_logger("feature").info(
+            "%.2f%% of feature (%d/%d rows, %.1f MB) cached in HBM "
+            "(device_replicate); cold tier: %s",
+            100.0 * hot_rows / max(n, 1),
+            hot_rows,
+            n,
+            hot_rows * row_bytes / 2**20,
+            "pinned host" if self._cold_is_host else ("none" if hot_rows == n else "device"),
+        )
         return self
 
     @classmethod
@@ -146,9 +157,10 @@ class Feature:
             if self.cold is None
             else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
         )
-        return tiered_lookup(
-            n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather
-        )
+        with trace_scope("feature_gather"):
+            return tiered_lookup(
+                n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather
+            )
 
     def size(self, dim: int) -> int:
         return self.shape[dim]

@@ -33,6 +33,7 @@ from .feature import tiered_lookup
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
 from ..ops.sample import staged_gather
+from ..utils.trace import get_logger
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
 from ..utils.reorder import reorder_by_degree
 
@@ -186,6 +187,18 @@ class ShardedFeature:
             self.cold, self._cold_is_host = to_pinned_host(
                 tensor[hot_rows:], mesh=self.mesh
             )
+        # placement report (reference shard_tensor.py:153-162 LOG>>> parity)
+        get_logger("feature").info(
+            "%.2f%% of feature (%d/%d rows) sharded over %d devices on "
+            "mesh axis '%s' (%.1f MB/device); cold tier: %s",
+            100.0 * hot_rows / max(n, 1),
+            hot_rows,
+            n,
+            num_shards,
+            self.axis,
+            hot_rows * row_bytes / num_shards / 2**20,
+            "pinned host" if self._cold_is_host else ("none" if hot_rows == n else "device"),
+        )
         return self
 
     @property

@@ -1,0 +1,34 @@
+from .mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
+
+__all__ = [
+    "MeshTopo",
+    "make_mesh",
+    "init_p2p",
+    "can_device_access_peer",
+    "Batch",
+    "Prefetcher",
+    "init_model",
+    "make_train_step",
+    "make_eval_step",
+    "DistributedTrainer",
+]
+
+_LAZY = {
+    "Batch": "pipeline",
+    "Prefetcher": "pipeline",
+    "init_model": "train",
+    "make_train_step": "train",
+    "make_eval_step": "train",
+    "DistributedTrainer": "trainer",
+}
+
+
+def __getattr__(name):
+    # trainer/train/pipeline import feature.*, which imports parallel.mesh —
+    # resolving them lazily keeps this package initializable from both sides
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -26,6 +26,7 @@ from ..core.config import SampleMode
 from ..core.topology import CSRTopo, DeviceTopology
 from ..ops.reindex import reindex_layer
 from ..ops.sample import sample_layer
+from ..utils.trace import trace_scope
 
 __all__ = ["Adj", "GraphSageSampler", "SampleOutput"]
 
@@ -93,8 +94,10 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
     total_overflow = jnp.zeros((), jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
-        nbr, _ = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
-        frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
+        with trace_scope(f"sample_layer_{l}"):
+            nbr, _ = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
+        with trace_scope(f"reindex_layer_{l}"):
+            frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
         S = cur.shape[0]
         row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
         row = jnp.where(col >= 0, row, -1)

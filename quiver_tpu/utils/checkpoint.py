@@ -1,0 +1,78 @@
+"""Checkpoint / resume for training state.
+
+The reference has no model checkpointing at all (SURVEY §5: examples use
+torch.save only for preprocessing artifacts, preprocess.py:54-106) — this is
+roadmap capability the TPU framework ships natively: orbax-backed, async-safe,
+multi-host-correct saves of (params, opt_state, step) with retention.
+
+>>> ckpt = Checkpointer("/tmp/run1", max_to_keep=3)
+>>> ckpt.save(step, {"params": params, "opt_state": opt_state})
+>>> state = ckpt.restore()                      # latest, exact saved tree
+>>> state = ckpt.restore(template=state0)       # shape/dtype/sharding-checked
+"""
+
+from __future__ import annotations
+
+import os
+
+import orbax.checkpoint as ocp
+
+__all__ = ["Checkpointer"]
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper for train-state pytrees.
+
+    Args:
+      directory: checkpoint root (created if missing; made absolute —
+        orbax requires absolute paths).
+      max_to_keep: retention window (oldest checkpoints deleted).
+    """
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state, wait: bool = False) -> None:
+        """Save a state pytree at ``step`` (async by default)."""
+        self._mngr.save(int(step), args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore(self, step: int | None = None, template=None):
+        """Restore the state at ``step`` (default: latest).
+
+        ``template`` (a matching pytree, e.g. the freshly-initialized state)
+        restores into the template's exact dtypes/shardings; without it the
+        tree is restored as saved.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        args = None if template is None else ocp.args.StandardRestore(template)
+        return self._mngr.restore(int(step), args=args)
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

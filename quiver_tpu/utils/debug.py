@@ -1,0 +1,38 @@
+"""Debug introspection helpers.
+
+``show_tensor_info`` is capability parity with the reference's debug binding
+(torch-quiver srcs/cpp/src/quiver/cpu/tensor.cpp:25-96), which prints an
+array's dtype/shape/device; here it also reports sharding and committed
+memory kind, the TPU-relevant placement facts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["show_tensor_info", "tensor_info"]
+
+
+def tensor_info(x) -> str:
+    """One-line description of an array's dtype, shape, and placement."""
+    if isinstance(x, jax.Array):
+        try:
+            devs = sorted(str(d) for d in x.devices())
+        except RuntimeError:  # deleted/donated buffers
+            devs = ["<deleted>"]
+        kind = getattr(getattr(x, "sharding", None), "memory_kind", None)
+        placement = devs[0] if len(devs) == 1 else f"{len(devs)} devices"
+        if kind:
+            placement += f", {kind}"
+        return f"jax.Array dtype={x.dtype} shape={tuple(x.shape)} [{placement}]"
+    x = np.asarray(x)
+    return f"numpy dtype={x.dtype} shape={x.shape} [host]"
+
+
+def show_tensor_info(x) -> str:
+    """Print and return :func:`tensor_info` (reference tensor.cpp:74-95)."""
+    s = tensor_info(x)
+    print(s)
+    return s

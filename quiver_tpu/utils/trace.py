@@ -1,0 +1,124 @@
+"""Tracing, timing, and structured logging.
+
+Capability parity with the reference's observability layer (SURVEY §5):
+
+- ``TRACE_SCOPE`` macros (torch-quiver trace.hpp:6-14) — compiled to no-ops
+  unless ``QUIVER_ENABLE_TRACE`` is set — become :func:`trace_scope`, which
+  annotates both the host timeline (``jax.profiler.TraceAnnotation``) and the
+  XLA program (``jax.named_scope``) and is a no-op unless tracing is enabled
+  via the same ``QUIVER_ENABLE_TRACE`` env var or :func:`enable_trace`.
+- the RAII wall-clock ``timer`` (timer.hpp:7-28) becomes :class:`Timer`.
+- the ad-hoc ``"LOG>>>"`` prints (feature.py:109-111, shard_tensor.py:69-71)
+  become a real structured logger under the ``quiver_tpu`` namespace.
+- profile *collection* (the stdtracer role, fetch_stdtracer.cmake:11-17) is
+  :func:`start_trace`/:func:`stop_trace` over ``jax.profiler`` — the result
+  opens in TensorBoard/Perfetto instead of a text dump.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+import jax
+
+__all__ = [
+    "enable_trace",
+    "disable_trace",
+    "trace_enabled",
+    "trace_scope",
+    "Timer",
+    "get_logger",
+    "start_trace",
+    "stop_trace",
+]
+
+_TRACE_ENV = "QUIVER_ENABLE_TRACE"
+_enabled: bool | None = None  # None = consult env var
+
+
+def trace_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get(_TRACE_ENV, "0") not in ("", "0", "false", "False")
+
+
+def enable_trace() -> None:
+    """Turn trace scopes on for this process (overrides the env var)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_trace() -> None:
+    global _enabled
+    _enabled = False
+
+
+@contextlib.contextmanager
+def trace_scope(name: str):
+    """Annotate a region on the host profiler timeline and in the jaxpr.
+
+    No-op (zero overhead beyond one branch) unless tracing is enabled,
+    mirroring the reference's compile-time-gated TRACE_SCOPE. Usable around
+    both eager host code (shows up as a TraceAnnotation slice) and traced
+    code (names the XLA ops for the device timeline).
+    """
+    if not trace_enabled():
+        yield
+        return
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+class Timer:
+    """RAII wall-clock timer (reference timer.hpp:7-28 parity).
+
+    >>> with Timer("sample") as t:
+    ...     out = sampler.sample(seeds)
+    prints ``[sample] 12.3 ms`` at scope exit (via the package logger) and
+    leaves the duration in ``t.seconds``.
+    """
+
+    def __init__(self, name: str, sync=None, quiet: bool = False):
+        self.name = name
+        self.seconds = 0.0
+        self._sync = sync  # optional array/pytree to block_until_ready on exit
+        self._quiet = quiet
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync is not None:
+            jax.block_until_ready(self._sync)
+        self.seconds = time.perf_counter() - self._t0
+        if not self._quiet:
+            get_logger().info("[%s] %.1f ms", self.name, self.seconds * 1e3)
+        return False
+
+
+def get_logger(child: str | None = None) -> logging.Logger:
+    """The package logger (replaces the reference's LOG>>> prints)."""
+    logger = logging.getLogger("quiver_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(h)
+        logger.setLevel(os.environ.get("QUIVER_LOG_LEVEL", "INFO"))
+        logger.propagate = False
+    return logger.getChild(child) if child else logger
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin collecting a device+host profile (TensorBoard/Perfetto format)."""
+    enable_trace()
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()

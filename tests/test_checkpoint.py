@@ -1,0 +1,72 @@
+"""Checkpoint/resume tests: round-trip, retention, latest-step, resume-training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from quiver_tpu.utils.checkpoint import Checkpointer
+
+
+def _tree_equal(a, b):
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)},
+        "step": jnp.int32(7),
+    }
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(7, state, wait=True)
+        _tree_equal(ckpt.restore(), state)
+        _tree_equal(ckpt.restore(template=state), state)
+
+
+def test_latest_and_retention(tmp_path):
+    with Checkpointer(tmp_path / "ck", max_to_keep=2) as ckpt:
+        for s in (1, 2, 3):
+            ckpt.save(s, {"x": jnp.full(2, float(s))}, wait=True)
+        assert ckpt.latest_step() == 3
+        assert ckpt.all_steps() == [2, 3]
+        _tree_equal(ckpt.restore(), {"x": jnp.full(2, 3.0)})
+        _tree_equal(ckpt.restore(step=2), {"x": jnp.full(2, 2.0)})
+
+
+def test_restore_empty_raises(tmp_path):
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore()
+
+
+def test_resume_training_continues_identically(tmp_path):
+    """Save at step k, keep training; restore and retrain — same result."""
+    tx = optax.adam(1e-2)
+    params = {"w": jnp.ones((4, 4))}
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, i):
+        grads = jax.tree.map(lambda p: p * 0.01 * (i + 1), params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    for i in range(3):
+        params, opt_state = step(params, opt_state, i)
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(3, {"params": params, "opt_state": opt_state}, wait=True)
+        for i in range(3, 6):
+            params, opt_state = step(params, opt_state, i)
+
+        restored = ckpt.restore(
+            template={"params": params, "opt_state": opt_state}
+        )
+        p2, o2 = restored["params"], restored["opt_state"]
+        for i in range(3, 6):
+            p2, o2 = step(p2, o2, i)
+    _tree_equal(p2, params)

@@ -100,10 +100,8 @@ def test_hetero_sampled_edges_are_real():
     }
     # walk layers from seeds outward: position 1 is hop 0 (targets = seeds
     # frontier), position 0 is hop 1
-    frontiers = {"paper": np.asarray(out.n_id["paper"])}
     checked = 0
     for layer in reversed(out.adjs):
-        next_frontiers = {}
         for et, adj in layer.adjs.items():
             s_t, _, d_t = et
             src, dst = np.asarray(adj.edge_index)
@@ -116,7 +114,6 @@ def test_hetero_sampled_edges_are_real():
                 v = int(np.asarray(out.n_id[d_t])[dl])
                 assert (u, v) in adj_sets[et], f"{et}: ({u},{v}) not an edge"
                 checked += 1
-        frontiers = next_frontiers
     assert checked > 50
 
 
