@@ -1,0 +1,54 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.sampling.sampler import multilayer_sample
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+ei = generate_pareto_graph(2_450_000, 50.5, seed=0)
+topo_h = CSRTopo(edge_index=ei); del ei
+s = GraphSageSampler(topo_h, [15,10,5], seed_capacity=2048, seed=0)
+run, caps = s._compiled(2048)
+rng = np.random.default_rng(0)
+key = jax.random.PRNGKey(0)
+
+seeds = jnp.asarray(rng.integers(0, topo_h.node_count, 2048).astype(np.int32))
+ns = jnp.int32(2048)
+
+# warm
+out = run(s.topo, seeds, ns, key); jax.block_until_ready(out)
+t0=time.time(); iters=10
+for i in range(iters):
+    out = run(s.topo, seeds, ns, jax.random.fold_in(key, i))
+jax.block_until_ready(out)
+print(f"fused multilayer, block at end: {(time.time()-t0)/iters*1e3:.1f} ms/iter")
+
+t0=time.time()
+for i in range(iters):
+    out = run(s.topo, seeds, ns, jax.random.fold_in(key, i))
+    jax.block_until_ready(out)
+print(f"fused multilayer, block each iter: {(time.time()-t0)/iters*1e3:.1f} ms/iter")
+
+# same but via .sample() host path
+t0=time.time()
+for i in range(iters):
+    o = s.sample(np.asarray(rng.integers(0, topo_h.node_count, 2048)))
+    jax.block_until_ready(o.n_id)
+print(f".sample() host path, block each: {(time.time()-t0)/iters*1e3:.1f} ms/iter")
+
+# unfused: layer-by-layer in separate jits
+from quiver_tpu.ops.sample import sample_layer
+from quiver_tpu.ops.reindex import reindex_layer
+sl = jax.jit(sample_layer, static_argnums=(3,))
+rl = jax.jit(reindex_layer, static_argnums=(3,))
+def unfused(topo, seeds, ns, key):
+    cur, cn = seeds, ns
+    for l,k in enumerate((15,10,5)):
+        key, sub = jax.random.split(key)
+        nbr, _ = sl(topo, cur, cn, k, sub)
+        cur, cn, col, ov = rl(cur, cn, nbr, caps[l])
+    return cur, cn
+out = unfused(s.topo, seeds, ns, key); jax.block_until_ready(out)
+t0=time.time()
+for i in range(iters):
+    out = unfused(s.topo, seeds, ns, jax.random.fold_in(key, i))
+jax.block_until_ready(out)
+print(f"unfused per-layer jits: {(time.time()-t0)/iters*1e3:.1f} ms/iter")

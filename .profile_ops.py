@@ -1,0 +1,39 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+E = 62_623_643
+rng = np.random.default_rng(0)
+indices = jnp.asarray(rng.integers(0, 2_450_000, E, dtype=np.int64))
+indices32 = indices.astype(jnp.int32)
+
+def bench(name, fn, *args, iters=10):
+    out = jax.block_until_ready(jax.jit(fn)(*args))
+    t0=time.time()
+    for _ in range(iters):
+        out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    print(f"{name}: {(time.time()-t0)/iters*1e3:.2f} ms")
+    return out
+
+epos = jnp.asarray(rng.integers(0, E, 1_802_240, dtype=np.int64))
+epos32 = epos.astype(jnp.int32)
+bench("gather 1.8M from 62.6M int64 tbl/int64 idx", lambda t,i: t[i], indices, epos)
+bench("gather 1.8M from 62.6M int32 tbl/int32 idx", lambda t,i: t[i], indices32, epos32)
+
+v = jnp.asarray(rng.integers(0, 2_450_000, 2_162_688, dtype=np.int32))
+bench("argsort 2.16M int32 stable", lambda x: jnp.argsort(x, stable=True), v)
+bench("sort 2.16M int32", lambda x: jnp.sort(x), v)
+bench("cumsum 2.16M int32", lambda x: jnp.cumsum(x), v.astype(jnp.int32))
+perm = jnp.asarray(rng.permutation(2_162_688).astype(np.int32))
+bench("scatter-set 2.16M", lambda x,p: jnp.zeros(2_162_688, jnp.int32).at[p].set(x), v, perm)
+bench("gather 2.16M from 2.16M", lambda x,p: x[p], v, perm)
+
+v36 = v[:360_448]
+bench("argsort 360k int32 stable", lambda x: jnp.argsort(x, stable=True), v36)
+
+deg = jnp.asarray(rng.integers(0, 100, 360_448, dtype=np.int32))
+from quiver_tpu.ops.sample import stratified_offsets, rotate_offsets
+key = jax.random.PRNGKey(0)
+def offs(key, deg):
+    o, m = stratified_offsets(key, deg, 5)
+    return rotate_offsets(key, o, deg, 5)
+bench("stratified+rotate 360k x5", offs, key, deg)

@@ -1,0 +1,24 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+ei = generate_pareto_graph(2_450_000, 50.5, seed=0)
+topo = CSRTopo(edge_index=ei); del ei
+rng = np.random.default_rng(0)
+s = GraphSageSampler(topo, [15,10,5], seed_capacity=2048, seed=0)
+out = s.sample(rng.integers(0, topo.node_count, 2048))
+jax.block_until_ready(out.n_id)
+for it in range(12):
+    t0=time.time()
+    seeds = rng.integers(0, topo.node_count, 2048)
+    t1=time.time()
+    out = s.sample(seeds)
+    t2=time.time()
+    jax.block_until_ready(out.n_id)
+    t3=time.time()
+    print(f"iter {it}: seedgen {1e3*(t1-t0):.1f} dispatch {1e3*(t2-t1):.1f} block {1e3*(t3-t2):.1f} ms")
+# now same seeds every iter
+seeds = rng.integers(0, topo.node_count, 2048)
+for it in range(4):
+    t0=time.time(); out = s.sample(seeds); jax.block_until_ready(out.n_id)
+    print(f"same-seeds iter {it}: {1e3*(time.time()-t0):.1f} ms")

@@ -1,0 +1,24 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+t0=time.time()
+ei = generate_pareto_graph(2_450_000, 50.5, seed=0)
+topo = CSRTopo(edge_index=ei); del ei
+print(f"build {time.time()-t0:.1f}s nodes={topo.node_count} edges={topo.edge_count}")
+rng = np.random.default_rng(0)
+
+for sizes in ([15], [15,10], [15,10,5]):
+    s = GraphSageSampler(topo, sizes, seed_capacity=2048, seed=0)
+    run, caps = s._compiled(2048)
+    print("sizes", sizes, "caps", caps)
+    out = s.sample(rng.integers(0, topo.node_count, 2048))
+    jax.block_until_ready(out.n_id)
+    t0=time.time(); iters=8
+    for _ in range(iters):
+        out = s.sample(rng.integers(0, topo.node_count, 2048))
+        jax.block_until_ready(out.n_id)
+    dt=(time.time()-t0)/iters
+    print(f"  {dt*1e3:.1f} ms/iter, n_count={int(out.n_count)}, overflow={int(out.overflow)}")
+    for a in out.adjs:
+        print("   adj", a.edge_index.shape, "valid", int(jnp.sum(a.edge_index[0]>=0)))

@@ -1,0 +1,36 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.ops.sample import sample_layer
+from quiver_tpu.ops.reindex import reindex_layer
+from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+ei = generate_pareto_graph(2_450_000, 50.5, seed=0)
+topo_h = CSRTopo(edge_index=ei); del ei
+topo = topo_h.to_device("HBM")
+rng = np.random.default_rng(0)
+key = jax.random.PRNGKey(0)
+
+def bench(name, fn, *args, iters=10):
+    f = jax.jit(fn)
+    out = jax.block_until_ready(f(*args))
+    t0=time.time()
+    for _ in range(iters): out = f(*args)
+    jax.block_until_ready(out)
+    print(f"{name}: {(time.time()-t0)/iters*1e3:.2f} ms")
+    return out
+
+# L3-like: S=360448 seeds (simulate valid 163k), k=5
+S = 360_448
+seeds = np.full(S, -1, np.int32); n_valid = 163_000
+seeds[:n_valid] = rng.integers(0, topo_h.node_count, n_valid)
+seeds = jnp.asarray(seeds)
+nbr, cnt = bench("L3 sample_layer (S=360k,k=5)", lambda t,s,n,k_: sample_layer(t,s,n,5,k_), topo, seeds, jnp.int32(n_valid), key)
+bench("L3 reindex_layer (T=2.16M)", lambda s,n,nb: reindex_layer(s,n,nb,2_162_688), seeds, jnp.int32(n_valid), nbr)
+
+# L2-like: S=32768, k=10
+S2=32_768
+seeds2 = np.full(S2, -1, np.int32); nv2=21_000
+seeds2[:nv2] = rng.integers(0, topo_h.node_count, nv2)
+seeds2 = jnp.asarray(seeds2)
+nbr2, cnt2 = bench("L2 sample_layer (S=32k,k=10)", lambda t,s,n,k_: sample_layer(t,s,n,10,k_), topo, seeds2, jnp.int32(nv2), key)
+bench("L2 reindex_layer (T=360k)", lambda s,n,nb: reindex_layer(s,n,nb,360_448), seeds2, jnp.int32(nv2), nbr2)

@@ -1,93 +1,14 @@
 """Headline benchmark: sampled edges per second (SEPS) on the real chip.
 
-Methodology mirrors the reference's bench_sampler.py:33-43 (SEPS = total
-sampled edges / synchronized wall time) on a products-scale synthetic
-power-law graph (the reference's dataset-free Pareto generator pattern,
-benchmarks/generated_graph/gen_graph.py). Per BASELINE.md, padded lanes are
-NOT counted — only valid (unmasked) edges — keeping the comparison against
-the reference's ragged outputs honest.
-
-Baseline: 34.29M SEPS — the reference's 1-GPU UVA number on ogbn-products,
-fanout [15,10,5] (docs/Introduction_en.md:41). We run the HBM-resident mode
-(reference "GPU" mode) because that is the TPU-idiomatic placement for a
-graph this size; the reference's own GPU mode is +30-40% over its UVA
-number (docs/Introduction_en.md:45).
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Thin wrapper over ``benchmarks.bench_sampler`` (single source of truth for
+the SEPS methodology — see benchmarks/README.md) with the headline config as
+defaults: products-scale synthetic power-law graph, fanout [15,10,5], batch
+2048, HBM-resident topology. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", ...}`` with vs_baseline against
+the reference's 34.29M 1-GPU UVA SEPS (docs/Introduction_en.md:41).
 """
 
-import argparse
-import json
-import sys
-import time
-
-import numpy as np
-
-
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--nodes", type=int, default=2_450_000)  # ogbn-products scale
-    p.add_argument("--avg-degree", type=float, default=50.5)  # products: 123.7M/2.45M
-    p.add_argument("--batch", type=int, default=2048)
-    p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
-    p.add_argument("--iters", type=int, default=30)
-    p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--mode", default="GPU", choices=["GPU", "UVA"])
-    p.add_argument("--seed", type=int, default=0)
-    args = p.parse_args()
-
-    import jax
-    import jax.numpy as jnp
-
-    from quiver_tpu import CSRTopo, GraphSageSampler
-    from quiver_tpu.utils.graphgen import generate_pareto_graph
-
-    t0 = time.time()
-    ei = generate_pareto_graph(args.nodes, args.avg_degree, seed=args.seed)
-    topo = CSRTopo(edge_index=ei)
-    del ei
-    print(
-        f"graph: {topo.node_count} nodes, {topo.edge_count} edges "
-        f"({time.time()-t0:.1f}s build); device={jax.devices()[0]}",
-        file=sys.stderr,
-    )
-
-    sampler = GraphSageSampler(
-        topo, args.fanout, mode=args.mode, seed_capacity=args.batch, seed=args.seed
-    )
-    rng = np.random.default_rng(args.seed)
-
-    # warmup (includes compile)
-    t0 = time.time()
-    for _ in range(args.warmup):
-        out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
-    jax.block_until_ready(out.n_id)
-    print(f"warmup+compile: {time.time()-t0:.1f}s", file=sys.stderr)
-
-    # timed loop; count only valid edges (mask sum), per BASELINE.md
-    total_edges = 0
-    t0 = time.time()
-    for _ in range(args.iters):
-        seeds = rng.integers(0, topo.node_count, args.batch)
-        out = sampler.sample(seeds)
-        for adj in out.adjs:
-            total_edges += int(jnp.sum(adj.edge_index[0] >= 0))
-    jax.block_until_ready(out.n_id)
-    dt = time.time() - t0
-
-    seps = total_edges / dt
-    baseline = 34.29e6  # reference 1-GPU UVA SEPS, products [15,10,5]
-    print(
-        json.dumps(
-            {
-                "metric": "sampled-edges/sec/chip",
-                "value": round(seps, 1),
-                "unit": "SEPS",
-                "vs_baseline": round(seps / baseline, 3),
-            }
-        )
-    )
-
+from benchmarks.bench_sampler import main
 
 if __name__ == "__main__":
     main()

@@ -47,8 +47,12 @@ def main():
     budget = int(args.cache_ratio * n) * args.feature_dim * 4
     feature = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(feat)
     del feat
+    # auto caps right-size every frontier to observed uniques — without this
+    # the deepest n_id is worst-case-padded and the feature gather + model
+    # aggregate run ~3x wider than needed (SURVEY §7.4.2)
     sampler = GraphSageSampler(
-        topo, args.fanout, seed_capacity=args.batch, seed=args.seed
+        topo, args.fanout, seed_capacity=args.batch, seed=args.seed,
+        frontier_caps="auto",
     )
     labels_all = jnp.asarray(
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)

@@ -57,7 +57,7 @@ def main():
     train_idx = rng.permutation(n)[: max(args.batch, n // 10)]
 
     sampler = GraphSageSampler(topo, args.fanout, seed_capacity=args.batch,
-                               seed=args.seed)
+                               seed=args.seed, frontier_caps="auto")
     model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
                       num_layers=len(args.fanout))
     tx = optax.adam(args.lr)

@@ -26,7 +26,6 @@ from .sampling.saint import (
     saint_subgraph,
 )
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
-from .utils.checkpoint import Checkpointer
 from .utils.debug import show_tensor_info, tensor_info
 from .utils.reorder import reorder_by_degree
 from .utils.trace import Timer, enable_trace, get_logger, trace_scope
@@ -74,3 +73,13 @@ __all__ = [
 ]
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # orbax-checkpoint is an optional extra (pyproject [checkpoint]); resolve
+    # Checkpointer lazily so base installs can import the package without it
+    if name == "Checkpointer":
+        from .utils.checkpoint import Checkpointer
+
+        return Checkpointer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -18,7 +18,13 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["MeshTopo", "make_mesh", "init_p2p", "can_device_access_peer"]
+__all__ = [
+    "MeshTopo",
+    "make_mesh",
+    "init_p2p",
+    "can_device_access_peer",
+    "init_distributed",
+]
 
 DATA_AXIS = "data"
 FEATURE_AXIS = "feature"
@@ -106,3 +112,25 @@ def init_p2p(device_list=None) -> None:
     """No-op parity shim (reference utils.py:234-240): ICI peer access needs
     no explicit enablement on TPU."""
     return None
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host job (the reference's future-work story,
+    docs/Introduction_en.md:171 "Distributed Quiver").
+
+    Thin wrapper over ``jax.distributed.initialize``: on TPU pods every
+    argument is auto-discovered from the environment, so a bare
+    ``init_distributed()`` at program start is enough; after it,
+    ``jax.devices()`` spans all hosts and :func:`make_mesh` builds
+    DCN-spanning meshes transparently (ICI collectives within a slice, DCN
+    across). Call once per host process, before any other jax use.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

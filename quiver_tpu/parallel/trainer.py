@@ -116,7 +116,7 @@ class DistributedTrainer:
             key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
             sample_key, dropout_key = jax.random.split(key)
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
-            n_id, _, adjs, _ = multilayer_sample(
+            n_id, _, adjs, _, _, _ = multilayer_sample(
                 topo, seeds, num_seeds, sample_key, sizes, caps
             )
             x = gather_features(hot_table, n_id)
@@ -156,7 +156,7 @@ class DistributedTrainer:
         padded = np.full(self.local_batch, -1, np.int32)
         padded[:m] = np.arange(m)
         run, caps = self.sampler._compiled(self.local_batch)
-        _, _, adjs, _ = run(
+        _, _, adjs, _, _, _ = run(
             self.sampler.topo, jnp.asarray(padded), jnp.int32(m), jax.random.PRNGKey(0)
         )
         hot = (

@@ -74,6 +74,8 @@ class SampleOutput(NamedTuple):
     adjs: list  # deepest layer first
     n_count: jax.Array  # scalar: valid entries in n_id
     overflow: jax.Array  # scalar: uniques dropped by frontier caps (0 = exact)
+    edge_counts: tuple = ()  # per-layer valid-edge scalars, deepest first
+    frontier_counts: tuple = ()  # per-layer UNCLIPPED unique counts, deepest first
 
 
 def _round_up(x: int, m: int) -> int:
@@ -87,15 +89,18 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
     per-hop Python loop of C++ calls (sage_sampler.py:84-112). Shapes are
     fully static: ``sizes`` and ``caps`` are tuples of ints.
 
-    Returns (n_id, n_count, adjs deepest-first, overflow).
+    Returns (n_id, n_count, adjs deepest-first, overflow, per-layer edge
+    counts, per-layer unclipped frontier counts).
     """
     adjs = []
+    edge_counts = []
+    frontier_counts = []
     cur, cur_n = seeds, num_seeds
     total_overflow = jnp.zeros((), jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
         with trace_scope(f"sample_layer_{l}"):
-            nbr, _ = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
+            nbr, counts = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
         with trace_scope(f"reindex_layer_{l}"):
             frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
         S = cur.shape[0]
@@ -103,9 +108,18 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
         row = jnp.where(col >= 0, row, -1)
         edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
         adjs.append(Adj(edge_index, None, (caps[l], S)))
+        # per-layer tallies in-program: benchmarks and the auto-cap planner
+        # read scalars instead of reducing (2, E_cap) arrays on the host
+        # path. Tallied POST-reindex (col >= 0), so overflow-dropped
+        # neighbors are excluded — edge_counts[i] always equals the valid
+        # edges actually present in adjs[i] (BASELINE.md honesty rule)
+        del counts
+        edge_counts.append(jnp.sum((col >= 0).astype(jnp.int32)))
+        frontier_counts.append(n_frontier + overflow)
         cur, cur_n = frontier, n_frontier
         total_overflow = total_overflow + overflow
-    return cur, cur_n, adjs[::-1], total_overflow
+    return (cur, cur_n, adjs[::-1], total_overflow, tuple(edge_counts[::-1]),
+            tuple(frontier_counts[::-1]))
 
 
 class GraphSageSampler:
@@ -119,9 +133,15 @@ class GraphSageSampler:
       seed_capacity: padded batch size; defaults to first sample() call's
         batch rounded up to a multiple of 128.
       frontier_caps: per-layer unique-node capacity; defaults to
-        min(worst-case growth, node_count).
+        min(worst-case growth, node_count). Pass ``"auto"`` to right-size
+        caps from the first batch's observed unique counts (×``auto_margin``)
+        — worst-case caps vastly overshoot on power-law graphs (SURVEY
+        §7.4.2), inflating every downstream gather/aggregate; auto mode
+        trades one recompile (plus a rare recompile+resample when a later
+        batch overflows the planned caps) for right-sized programs.
       seed: base PRNG seed (per-call keys derive from it + a call counter,
         like the reference's per-launch curand reseed, cuda_random.cu.hpp:21-23).
+      auto_margin: headroom factor for "auto" caps (>= 1).
     """
 
     def __init__(
@@ -131,9 +151,10 @@ class GraphSageSampler:
         device=None,
         mode: str | SampleMode = SampleMode.HBM,
         seed_capacity: int | None = None,
-        frontier_caps: Sequence[int] | None = None,
+        frontier_caps: Sequence[int] | str | None = None,
         seed: int = 0,
         weighted: bool = False,
+        auto_margin: float = 1.25,
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -149,7 +170,13 @@ class GraphSageSampler:
             )
         self.topo = csr_topo.to_device(self.mode, with_weights=self.weighted)
         self._seed_capacity = seed_capacity
-        if frontier_caps is not None:
+        self._auto_caps = frontier_caps == "auto"
+        self._auto_margin = float(auto_margin)
+        if self._auto_margin < 1.0:
+            raise ValueError(f"auto_margin must be >= 1.0, got {auto_margin}")
+        if self._auto_caps:
+            frontier_caps = None  # first call plans from worst case
+        elif frontier_caps is not None:
             frontier_caps = tuple(int(c) for c in frontier_caps)
             if len(frontier_caps) != len(self.sizes):
                 raise ValueError(
@@ -166,9 +193,7 @@ class GraphSageSampler:
 
     # -- static-shape planning ---------------------------------------------
 
-    def _caps_for(self, seed_cap: int) -> tuple[int, ...]:
-        if self._frontier_caps is not None:
-            return self._frontier_caps
+    def _worst_caps(self, seed_cap: int) -> tuple[int, ...]:
         caps = []
         cur = seed_cap
         n = self.csr_topo.node_count
@@ -181,12 +206,33 @@ class GraphSageSampler:
             caps.append(cur)
         return tuple(caps)
 
+    def _caps_for(self, seed_cap: int) -> tuple[int, ...]:
+        if self._frontier_caps is not None:
+            return self._frontier_caps
+        return self._worst_caps(seed_cap)
+
+    def _plan_auto(self, seed_cap: int, observed: Sequence[int]) -> None:
+        """Set frontier caps to margin × observed unclipped unique counts
+        (seeds-outward order), never shrinking below already-planned caps."""
+        worst = self._worst_caps(seed_cap)
+        old = self._frontier_caps or (0,) * len(worst)
+        caps, prev = [], seed_cap
+        for w, o, c in zip(worst, observed, old):
+            cap = _round_up(int(self._auto_margin * o), 128)
+            cap = max(cap, prev, c, 128)
+            cap = min(cap, w)
+            caps.append(cap)
+            prev = cap
+        self._frontier_caps = tuple(caps)
+
     def _compiled(self, seed_cap: int):
-        # instance-level memo (a functools.cache on a method would pin the
-        # sampler and its device arrays in a class-level cache forever)
-        if seed_cap in self._compiled_cache:
-            return self._compiled_cache[seed_cap]
+        # instance-level memo keyed on the full static plan (a functools.cache
+        # on a method would pin the sampler and its device arrays in a
+        # class-level cache forever; auto mode re-plans caps per seed_cap)
         caps = self._caps_for(seed_cap)
+        cache_key = (seed_cap, caps)
+        if cache_key in self._compiled_cache:
+            return self._compiled_cache[cache_key]
         sizes = self.sizes
         weighted = self.weighted
 
@@ -195,7 +241,7 @@ class GraphSageSampler:
             return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
                                      weighted=weighted)
 
-        self._compiled_cache[seed_cap] = (run, caps)
+        self._compiled_cache[cache_key] = (run, caps)
         return run, caps
 
     # -- public API ----------------------------------------------------------
@@ -203,9 +249,9 @@ class GraphSageSampler:
     def sample(self, input_nodes) -> SampleOutput:
         """Sample k-hop neighborhoods of ``input_nodes``.
 
-        Returns SampleOutput(n_id, batch_size, adjs, n_count, overflow) where
-        ``adjs`` is deepest-layer-first, matching the reference's
-        ``adjs[::-1]`` return (sage_sampler.py:112).
+        Returns a SampleOutput whose ``adjs`` is deepest-layer-first,
+        matching the reference's ``adjs[::-1]`` return (sage_sampler.py:112);
+        ``edge_counts``/``frontier_counts`` carry per-layer in-program tallies.
         """
         seeds = np.asarray(input_nodes)
         batch = int(seeds.shape[0])
@@ -222,10 +268,43 @@ class GraphSageSampler:
         run, _ = self._compiled(cap)
         self._call += 1
         key = jax.random.fold_in(self._key, self._call)
-        n_id, n_count, adjs, overflow = run(
-            self.topo, jnp.asarray(padded), jnp.int32(batch), key
+        dev_seeds = jnp.asarray(padded)
+        n_id, n_count, adjs, overflow, edge_counts, frontier_counts = run(
+            self.topo, dev_seeds, jnp.int32(batch), key
         )
-        return SampleOutput(n_id, batch, adjs, n_count, overflow)
+        if self._auto_caps:
+            first_plan = self._frontier_caps is None
+            # auto mode pays one scalar sync per call to watch for overflow.
+            # Regrow converges in <= num_layers rounds (each round's caps
+            # cover that round's observed counts); the bound guards the
+            # saturation corner where duplicate forced seed lanes push
+            # uniques past node_count and even worst-case caps overflow —
+            # then the clipped result + overflow report stand, as in
+            # fixed-caps mode.
+            for _ in range(len(self.sizes) + 2):
+                if not first_plan and int(overflow) == 0:
+                    break
+                observed = [int(c) for c in frontier_counts[::-1]]
+                before = self._frontier_caps
+                self._plan_auto(cap, observed)
+                if not first_plan and self._frontier_caps == before:
+                    # saturated: caps already at worst case and still
+                    # overflowing — rerunning the identical program cannot
+                    # help; return the clipped result + overflow report
+                    break
+                if first_plan and int(overflow) == 0:
+                    # worst-case first run: result stands, later calls use
+                    # the tight plan
+                    first_plan = False
+                    break
+                run, _ = self._compiled(cap)
+                n_id, n_count, adjs, overflow, edge_counts, frontier_counts = run(
+                    self.topo, dev_seeds, jnp.int32(batch), key
+                )
+                first_plan = False
+        return SampleOutput(
+            n_id, batch, adjs, n_count, overflow, edge_counts, frontier_counts
+        )
 
     def sample_padded(self, topo, seeds, num_seeds, key):
         """Jit-composable sampling on already-padded device seeds.

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["reorder_by_degree"]
+__all__ = ["reorder_by_degree", "reindex_by_config"]
 
 
 def reorder_by_degree(
@@ -52,3 +52,12 @@ def reorder_by_degree(
     if n <= np.iinfo(np.int32).max:
         new_order = new_order.astype(np.int32)
     return new_feature, new_order
+
+
+def reindex_by_config(adj_csr, graph_feature, gpu_portion, seed: int = 0):
+    """Reference-signature alias (torch-quiver utils.py:213-224):
+    ``reindex_by_config(csr_topo, feature, gpu_portion)`` ->
+    (reordered_feature, new_order)."""
+    return reorder_by_degree(
+        np.asarray(graph_feature), adj_csr.degree, gpu_portion, seed=seed
+    )

@@ -1,0 +1,97 @@
+"""Auto frontier-cap planning tests: tightening, equivalence with
+worst-case caps, overflow-triggered regrow, monotone caps invariant."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+
+
+@pytest.fixture(scope="module")
+def topo():
+    rng = np.random.default_rng(0)
+    ei = rng.integers(0, 5000, size=(2, 30000)).astype(np.int64)
+    return CSRTopo(edge_index=ei)
+
+
+def _valid_edges(out):
+    edges = set()
+    for li, adj in enumerate(out.adjs):
+        src, dst = np.asarray(adj.edge_index)
+        for s, d in zip(src, dst):
+            if s >= 0:
+                edges.add((li, int(s), int(d)))
+    return edges
+
+
+def test_auto_tightens_after_first_call(topo):
+    s = GraphSageSampler(topo, [5, 5], seed_capacity=64, frontier_caps="auto", seed=1)
+    worst = s._worst_caps(64)
+    assert s._frontier_caps is None
+    out1 = s.sample(np.arange(64))
+    assert s._frontier_caps is not None
+    assert all(c <= w for c, w in zip(s._frontier_caps, worst))
+    assert s._frontier_caps[-1] < worst[-1]  # genuinely tighter deep cap
+    # caps are monotone non-decreasing (forced-lane requirement)
+    assert list(s._frontier_caps) == sorted(s._frontier_caps)
+    # second call runs under the tight plan with smaller output width
+    out2 = s.sample(np.arange(64))
+    assert out2.n_id.shape[0] == s._frontier_caps[-1] < out1.n_id.shape[0]
+    assert int(out2.overflow) == 0
+
+
+def test_auto_matches_worst_case_results(topo):
+    """Same base seed => same per-call keys => identical valid samples,
+    regardless of cap width."""
+    a = GraphSageSampler(topo, [4, 3], seed_capacity=32, seed=9)
+    b = GraphSageSampler(topo, [4, 3], seed_capacity=32, frontier_caps="auto", seed=9)
+    seeds = np.random.default_rng(5).integers(0, topo.node_count, 32)
+    for _ in range(3):  # incl. calls after b's plan tightened
+        oa, ob = a.sample(seeds), b.sample(seeds)
+        na, nb = int(oa.n_count), int(ob.n_count)
+        assert na == nb
+        np.testing.assert_array_equal(
+            np.asarray(oa.n_id[:na]), np.asarray(ob.n_id[:nb])
+        )
+        assert _valid_edges(oa) == _valid_edges(ob)
+
+
+def test_auto_regrows_on_overflow(topo):
+    """Plan on a degenerate batch (all-duplicate seeds -> tiny frontier),
+    then feed a diverse batch that must overflow and regrow."""
+    s = GraphSageSampler(
+        topo, [4, 3], seed_capacity=32, frontier_caps="auto", seed=2,
+        auto_margin=1.0,
+    )
+    s.sample(np.full(32, 7))  # tiny observed frontier
+    tiny = s._frontier_caps
+    out = s.sample(np.random.default_rng(0).integers(0, topo.node_count, 32))
+    assert s._frontier_caps != tiny  # regrew
+    assert int(out.overflow) == 0  # resample under grown caps is exact
+    # equivalence with a fixed-caps sampler at the same call count
+    ref = GraphSageSampler(topo, [4, 3], seed_capacity=32, seed=2)
+    ref.sample(np.full(32, 7))
+    oref = ref.sample(np.random.default_rng(0).integers(0, topo.node_count, 32))
+    n = int(oref.n_count)
+    assert int(out.n_count) == n
+    np.testing.assert_array_equal(np.asarray(out.n_id[:n]), np.asarray(oref.n_id[:n]))
+    assert _valid_edges(out) == _valid_edges(oref)
+
+
+def test_auto_margin_validation(topo):
+    with pytest.raises(ValueError, match="auto_margin"):
+        GraphSageSampler(topo, [3], frontier_caps="auto", auto_margin=0.5)
+
+
+def test_edge_and_frontier_counts_reported(topo):
+    s = GraphSageSampler(topo, [4, 3], seed_capacity=32, seed=0)
+    out = s.sample(np.arange(32))
+    assert len(out.edge_counts) == 2 and len(out.frontier_counts) == 2
+    # deepest-first: edge_counts[i] == valid edges of adjs[i]
+    for c, adj in zip(out.edge_counts, out.adjs):
+        assert int(c) == int(jnp.sum(adj.edge_index[0] >= 0))
+    # unclipped frontier count of the deepest layer == n_count when no overflow
+    assert int(out.overflow) == 0
+    assert int(out.frontier_counts[0]) == int(out.n_count)
