@@ -28,6 +28,8 @@ def main():
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=47)
     p.add_argument("--cache-ratio", type=float, default=0.2)
+    p.add_argument("--model", default="sage", choices=["sage", "gat"])
+    p.add_argument("--heads", type=int, default=4)
     p.add_argument("--train-nodes", type=int, default=PRODUCTS_TRAIN_NODES)
     p.set_defaults(batch=1024, iters=40, warmup=3)
     args = p.parse_args()
@@ -58,9 +60,16 @@ def main():
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)
     )
 
-    model = GraphSAGE(
-        hidden=args.hidden, num_classes=args.classes, num_layers=len(args.fanout)
-    )
+    if args.model == "gat":
+        from quiver_tpu.models.gat import GAT
+
+        model = GAT(hidden=args.hidden, num_classes=args.classes,
+                    num_layers=len(args.fanout), heads=args.heads)
+    else:
+        model = GraphSAGE(
+            hidden=args.hidden, num_classes=args.classes,
+            num_layers=len(args.fanout)
+        )
     tx = optax.adam(1e-3)
     step = jax.jit(make_train_step(model, tx))
 
@@ -112,6 +121,7 @@ def main():
         iter_ms=round(iter_s * 1e3, 2),
         iters_per_epoch=iters_per_epoch,
         batch=args.batch,
+        model=args.model,
         final_loss=round(float(loss), 4),
     )
 

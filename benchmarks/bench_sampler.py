@@ -48,7 +48,8 @@ def main():
     t0 = time.time()
     for _ in range(args.iters):
         out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
-        total_edges += sum(int(c) for c in out.edge_counts)
+        # one device->host scalar read per iter (sum folds on device)
+        total_edges += int(sum(out.edge_counts))
     jax.block_until_ready(out.n_id)
     dt = time.time() - t0
 

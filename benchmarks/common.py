@@ -39,7 +39,15 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
 
 def build_graph(args):
     """Synthetic products-scale power-law CSRTopo (+ build-time report)."""
+    import os
+
     import jax
+
+    # honor a JAX_PLATFORMS=cpu request via config (the image's sitecustomize
+    # pins the TPU plugin before env vars are read; backend init is lazy so
+    # this still takes effect — same workaround as tests/conftest.py)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        jax.config.update("jax_platforms", "cpu")
 
     from quiver_tpu import CSRTopo
     from quiver_tpu.utils.graphgen import generate_pareto_graph

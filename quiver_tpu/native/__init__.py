@@ -64,6 +64,10 @@ def _load():
             i64p, i32p, i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, i32p, i32p,
         ]
         lib.degrees_i64.argtypes = [i64p, ctypes.c_int64, i64p]
+        lib.reindex_cpu.argtypes = [
+            i32p, ctypes.c_int64, i32p, ctypes.c_int32, i32p, i32p,
+        ]
+        lib.reindex_cpu.restype = ctypes.c_int64
         lib.quiver_host_num_threads.restype = ctypes.c_int
     except (OSError, AttributeError):
         # torn/stale .so (e.g. built from older source, missing a symbol)
@@ -143,6 +147,36 @@ def sample_neighbors(indptr: np.ndarray, indices: np.ndarray, seeds: np.ndarray,
         _ptr(out, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
     )
     return out, counts
+
+
+def reindex(seeds: np.ndarray, neighbors: np.ndarray):
+    """Hash-based order-preserving reindex (native CPUQuiver::reindex_group
+    parity, reference quiver.cpp:39-84).
+
+    Args:
+      seeds: (S,) int32 node ids, -1 for padding; every valid seed keeps its
+        own frontier slot (duplicates included — PyG contract).
+      neighbors: (S, k) int32 sampled ids, -1 invalid.
+
+    Returns:
+      (frontier (M,) int32 seeds-first unique ids,
+       col (S, k) int32 frontier-local ids, -1 where invalid).
+    """
+    if not available:
+        raise RuntimeError("native library unavailable")
+    seeds = np.ascontiguousarray(seeds, np.int32)
+    neighbors = np.ascontiguousarray(neighbors, np.int32)
+    s, k = neighbors.shape
+    if seeds.shape[0] != s:
+        raise ValueError(f"seeds {seeds.shape} vs neighbors {neighbors.shape}")
+    frontier = np.empty(s * (k + 1), np.int32)
+    col = np.empty((s, k), np.int32)
+    m = _lib.reindex_cpu(
+        _ptr(seeds, ctypes.c_int32), s,
+        _ptr(neighbors, ctypes.c_int32), k,
+        _ptr(frontier, ctypes.c_int32), _ptr(col, ctypes.c_int32),
+    )
+    return frontier[:m].copy(), col
 
 
 def num_threads() -> int:

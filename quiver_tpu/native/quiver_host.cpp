@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <random>
+#include <unordered_map>
 #include <vector>
 
 #ifdef _OPENMP
@@ -211,6 +212,46 @@ void sample_neighbors_cpu(const int64_t* indptr, const int32_t* indices,
       }
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// Hash-based order-preserving reindex: frontier = unique(seeds ∪ neighbors)
+// with seeds forced first (duplicates kept as distinct slots), neighbor lanes
+// rewritten to frontier-local ids. Native parity with the reference's
+// CPUQuiver::reindex_group (quiver.cpp:39-84) under this framework's padded
+// (-1 sentinel) contract. Serial hash pass (like the reference's); the
+// OpenMP pass only rewrites lanes.
+// Returns the frontier length. frontier must have room for
+// n_seeds + n_seeds*k entries (worst case).
+// ---------------------------------------------------------------------------
+int64_t reindex_cpu(const int32_t* seeds, int64_t n_seeds,
+                    const int32_t* neighbors /* n_seeds*k */, int32_t k,
+                    int32_t* frontier /* cap >= n_seeds*(k+1) */,
+                    int32_t* col /* n_seeds*k */) {
+  std::unordered_map<int32_t, int32_t> first;
+  first.reserve((size_t)(n_seeds * (k + 1)));
+  int64_t m = 0;
+  // forced seed lanes: every valid seed occupies its own slot; the map keeps
+  // the FIRST occurrence so later duplicates resolve to it
+  for (int64_t i = 0; i < n_seeds; ++i) {
+    int32_t s = seeds[i];
+    if (s < 0) continue;
+    frontier[m] = s;
+    first.emplace(s, (int32_t)m);
+    ++m;
+  }
+  for (int64_t i = 0; i < n_seeds * k; ++i) {
+    int32_t v = neighbors[i];
+    if (v < 0) continue;
+    auto it = first.emplace(v, (int32_t)m);
+    if (it.second) frontier[m++] = v;
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_seeds * k; ++i) {
+    int32_t v = neighbors[i];
+    col[i] = v < 0 ? -1 : first.find(v)->second;
+  }
+  return m;
 }
 
 // ---------------------------------------------------------------------------

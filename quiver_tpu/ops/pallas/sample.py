@@ -33,7 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..sample import rotate_offsets, stratified_offsets
 
-__all__ = ["sample_layer_windowed"]
+__all__ = ["sample_layer_windowed", "DEFAULT_WINDOW"]
+
+# default neighbor-window length; callers deciding between this kernel and
+# the XLA path compare edge_count against it (quiver_tpu/sampling/sampler.py)
+DEFAULT_WINDOW = 2048
 
 
 def _kernel(tile: int, window: int, k: int,
@@ -88,7 +92,7 @@ def _run(indices, start, offs, tile, window, k, interpret):
 
 
 def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
-                          window: int = 2048, tile: int = 8,
+                          window: int = DEFAULT_WINDOW, tile: int = 8,
                           interpret: bool | None = None):
     """Windowed Pallas sampling; same (S, K)/-1 padded contract as
     ops.sample.sample_layer.

@@ -117,7 +117,8 @@ class DistributedTrainer:
             sample_key, dropout_key = jax.random.split(key)
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
             n_id, _, adjs, _, _, _ = multilayer_sample(
-                topo, seeds, num_seeds, sample_key, sizes, caps
+                topo, seeds, num_seeds, sample_key, sizes, caps,
+                weighted=sampler.weighted, kernel=sampler.kernel,
             )
             x = gather_features(hot_table, n_id)
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]

@@ -82,7 +82,8 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
+def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
+                      kernel="xla"):
     """The multi-layer sample+reindex loop (jit- and shard_map-composable).
 
     One trace covers all layers — the fused analogue of the reference's
@@ -100,7 +101,25 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False):
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
         with trace_scope(f"sample_layer_{l}"):
-            nbr, counts = sample_layer(topo, cur, cur_n, k, sub, weighted=weighted)
+            if kernel == "pallas":
+                if weighted:
+                    raise ValueError(
+                        "kernel='pallas' supports unweighted sampling only"
+                    )
+                from ..ops.pallas.sample import (
+                    DEFAULT_WINDOW,
+                    sample_layer_windowed,
+                )
+
+                # graphs smaller than the DMA window fall back to the XLA
+                # path (the kernel needs a full window; trace-time constant)
+                if topo.indices.shape[0] >= DEFAULT_WINDOW:
+                    nbr, counts = sample_layer_windowed(topo, cur, cur_n, k, sub)
+                else:
+                    nbr, counts = sample_layer(topo, cur, cur_n, k, sub)
+            else:
+                nbr, counts = sample_layer(topo, cur, cur_n, k, sub,
+                                           weighted=weighted)
         with trace_scope(f"reindex_layer_{l}"):
             frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
         S = cur.shape[0]
@@ -142,6 +161,9 @@ class GraphSageSampler:
       seed: base PRNG seed (per-call keys derive from it + a call counter,
         like the reference's per-launch curand reseed, cuda_random.cu.hpp:21-23).
       auto_margin: headroom factor for "auto" caps (>= 1).
+      kernel: "xla" (exact stratified sampler) or "pallas" (windowed-DMA
+        Pallas kernel, ops/pallas/sample.py — HBM mode, unweighted only;
+        near-identical distribution, see the kernel's module docstring).
     """
 
     def __init__(
@@ -155,6 +177,7 @@ class GraphSageSampler:
         seed: int = 0,
         weighted: bool = False,
         auto_margin: float = 1.25,
+        kernel: str = "xla",
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -163,6 +186,14 @@ class GraphSageSampler:
         if any(k < 1 for k in self.sizes):
             raise ValueError(f"fanouts must be >= 1 or -1, got {sizes}")
         self.weighted = bool(weighted)
+        self.kernel = str(kernel)
+        if self.kernel not in ("xla", "pallas"):
+            raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
+        if self.kernel == "pallas":
+            if weighted:
+                raise ValueError("kernel='pallas' supports unweighted sampling only")
+            if SampleMode.parse(mode) is not SampleMode.HBM:
+                raise ValueError("kernel='pallas' requires mode='HBM' (GPU) topology")
         if self.weighted and csr_topo.cum_weights is None:
             raise ValueError(
                 "weighted=True requires edge weights; call "
@@ -235,11 +266,12 @@ class GraphSageSampler:
             return self._compiled_cache[cache_key]
         sizes = self.sizes
         weighted = self.weighted
+        kernel = self.kernel
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
             return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
-                                     weighted=weighted)
+                                     weighted=weighted, kernel=kernel)
 
         self._compiled_cache[cache_key] = (run, caps)
         return run, caps

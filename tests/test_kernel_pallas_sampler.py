@@ -1,0 +1,94 @@
+"""GraphSageSampler kernel='pallas' integration tests (interpret mode on CPU):
+validity oracle, PyG contract, mode/weighted guards."""
+
+import numpy as np
+import pytest
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+
+
+@pytest.fixture(scope="module")
+def topo():
+    rng = np.random.default_rng(3)
+    ei = rng.integers(0, 400, size=(2, 6000)).astype(np.int64)
+    return CSRTopo(edge_index=ei)
+
+
+def _adjacency(topo):
+    adj = {}
+    indptr, indices = np.asarray(topo.indptr), np.asarray(topo.indices)
+    for v in range(topo.node_count):
+        adj[v] = set(indices[indptr[v]:indptr[v + 1]].tolist())
+    return adj
+
+
+def test_pallas_kernel_sample_validity(topo):
+    s = GraphSageSampler(topo, [5, 4], seed_capacity=64, seed=0, kernel="pallas")
+    seeds = np.random.default_rng(0).integers(0, topo.node_count, 64)
+    out = s.sample(seeds)
+    assert np.array_equal(np.asarray(out.n_id[:64]), seeds)
+    assert int(out.overflow) == 0
+    adj = _adjacency(topo)
+    n_id = np.asarray(out.n_id)
+    checked = 0
+    for a in out.adjs:
+        src, dst = np.asarray(a.edge_index)
+        # per-hop targets are a prefix of n_id (forced-first property)
+        for sl, dl in zip(src, dst):
+            if sl < 0:
+                continue
+            u, v = int(n_id[sl]), int(n_id[dl])
+            assert u in adj[v], f"sampled non-edge {u}->{v}"
+            checked += 1
+    assert checked > 100
+
+
+def test_pallas_kernel_per_row_distinct(topo):
+    s = GraphSageSampler(topo, [6], seed_capacity=32, seed=1, kernel="pallas")
+    out = s.sample(np.arange(32))
+    src, dst = np.asarray(out.adjs[0].edge_index)
+    indptr = np.asarray(topo.indptr)
+    deg = np.diff(indptr)
+    n_id = np.asarray(out.n_id)
+    indices = np.asarray(topo.indices)
+    per_row = {}
+    for sl, dl in zip(src, dst):
+        if sl >= 0:
+            per_row.setdefault(int(dl), []).append(int(n_id[sl]))
+    for r, nbrs in per_row.items():
+        v = int(n_id[r])
+        assert len(nbrs) == min(deg[v], 6)
+        row = indices[indptr[v]:indptr[v + 1]]
+        if deg[v] > 6 and len(set(row.tolist())) == deg[v]:
+            # draws are distinct CSR slots; on rows whose entries are all
+            # distinct, id distinctness == slot distinctness
+            assert len(set(nbrs)) == len(nbrs), f"row {v} repeated a slot"
+
+
+def test_pallas_kernel_guards(topo):
+    with pytest.raises(ValueError, match="HBM"):
+        GraphSageSampler(topo, [3], mode="UVA", kernel="pallas")
+    with pytest.raises(ValueError, match="unweighted"):
+        GraphSageSampler(topo, [3], weighted=True, kernel="pallas")
+    with pytest.raises(ValueError, match="kernel"):
+        GraphSageSampler(topo, [3], kernel="cuda")
+
+
+def test_pallas_kernel_auto_caps_compose(topo):
+    s = GraphSageSampler(topo, [5, 4], seed_capacity=64, seed=0,
+                         kernel="pallas", frontier_caps="auto")
+    out1 = s.sample(np.arange(64))
+    assert s._frontier_caps is not None
+    out2 = s.sample(np.arange(64))
+    assert int(out2.overflow) == 0
+    assert out2.n_id.shape[0] <= out1.n_id.shape[0]
+
+
+def test_pallas_kernel_small_graph_fallback():
+    """Graphs with fewer edges than the DMA window fall back to the XLA path."""
+    rng = np.random.default_rng(0)
+    ei = rng.integers(0, 30, size=(2, 200)).astype(np.int64)  # E=200 < 2048
+    small = CSRTopo(edge_index=ei)
+    s = GraphSageSampler(small, [3], seed_capacity=16, seed=0, kernel="pallas")
+    out = s.sample(np.arange(16))
+    assert int(out.n_count) >= 16

@@ -106,3 +106,44 @@ def test_csrtopo_rejects_negative_ids():
     ei = np.array([[0, 1, -1], [1, 2, 0]])
     with pytest.raises(ValueError, match="negative"):
         CSRTopo(edge_index=ei)
+
+
+def test_native_reindex_matches_xla_masked_unique():
+    """Differential: native hash reindex == XLA sort-based masked_unique
+    (same first-occurrence order, forced seed lanes, -1 handling)."""
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.reindex import reindex_layer
+
+    if not native.available:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    S, K = 40, 6
+    seeds = rng.integers(0, 50, S).astype(np.int32)
+    seeds[35:] = -1  # padding tail
+    nbr = rng.integers(0, 50, (S, K)).astype(np.int32)
+    nbr[rng.random((S, K)) < 0.3] = -1
+    nbr[35:] = -1  # no neighbors for padded seeds
+
+    nf, ncol = native.reindex(seeds, nbr)
+
+    cap = S * (K + 1)
+    f, nfr, col, ov = reindex_layer(
+        jnp.asarray(seeds), jnp.int32(35), jnp.asarray(nbr), cap
+    )
+    m = int(nfr)
+    assert int(ov) == 0
+    assert m == nf.shape[0]
+    np.testing.assert_array_equal(np.asarray(f)[:m], nf)
+    np.testing.assert_array_equal(np.asarray(col), ncol)
+
+
+def test_native_reindex_duplicate_seeds_forced():
+    if not native.available:
+        pytest.skip("native library unavailable")
+    seeds = np.array([7, 7, 3], np.int32)
+    nbr = np.array([[7, 3], [9, -1], [7, 9]], np.int32)
+    f, col = native.reindex(seeds, nbr)
+    # both 7-lanes kept; neighbors resolve to FIRST occurrence (slot 0)
+    np.testing.assert_array_equal(f, [7, 7, 3, 9])
+    np.testing.assert_array_equal(col, [[0, 2], [3, -1], [0, 3]])
