@@ -205,6 +205,15 @@ class Feature:
         obj.csr_topo = None
         return obj
 
+    def delete(self) -> None:
+        """Free the device/host buffers now (reference ``shard_tensor.delete``,
+        SURVEY §2.5 — planned there, real here). The object is unusable after."""
+        for buf in (self.hot, self.cold, self.feature_order):
+            if buf is not None and hasattr(buf, "delete"):
+                buf.delete()
+        self.hot = self.cold = self.feature_order = None
+        self.hot_rows = 0
+
     # -- reference API shims (IPC is a no-op under single-controller SPMD) --
 
     def share_ipc(self):

@@ -113,6 +113,14 @@ class ShardedTensor:
         self._gather_cache[cache_key] = f
         return f
 
+    def delete(self) -> None:
+        """Free the sharded buffers now (reference ``shard_tensor.delete``,
+        SURVEY §2.5). The object is unusable after."""
+        if self.table is not None:
+            self.table.delete()
+        self.table = None
+        self._gather_cache.clear()
+
     def __getitem__(self, ids):
         """Standalone sharded gather: ids sharded over the data axis,
         result sharded the same way. For fused use inside a larger
@@ -204,6 +212,16 @@ class ShardedFeature:
     @property
     def cache_ratio(self) -> float:
         return self.hot_rows / self.shape[0] if self.shape else 0.0
+
+    def delete(self) -> None:
+        """Free hot/cold buffers now (reference ``shard_tensor.delete``)."""
+        if self.hot is not None:
+            self.hot.delete()
+        for buf in (self.cold, self.feature_order):
+            if buf is not None and hasattr(buf, "delete"):
+                buf.delete()
+        self.hot = self.cold = self.feature_order = None
+        self.hot_rows = 0
 
     def __getitem__(self, n_id):
         """Gather rows for data-axis-sharded (or replicated) node ids."""

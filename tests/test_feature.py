@@ -86,3 +86,16 @@ def test_lookup_inside_jit():
     expect = t[[1, 5, 150]].sum(axis=1)
     assert np.allclose(out[:3], expect, rtol=1e-5)
     assert out[3] == 0
+
+
+def test_feature_delete_frees_buffers():
+    """shard_tensor.delete parity (SURVEY §2.5): buffers freed, object inert."""
+    import pytest as _pytest
+
+    feat = np.random.default_rng(0).normal(size=(100, 8)).astype(np.float32)
+    f = Feature(device_cache_size=50 * 8 * 4).from_cpu_tensor(feat)
+    hot = f.hot
+    f.delete()
+    assert f.hot is None and f.cold is None and f.hot_rows == 0
+    with _pytest.raises(RuntimeError):
+        _ = np.asarray(hot)  # buffer really gone
