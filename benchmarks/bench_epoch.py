@@ -116,8 +116,8 @@ def main():
         "e2e-epoch-time",
         epoch_s,
         "s",
-        None,
-        vs_baseline=round(BASELINE_EPOCH_S / epoch_s, 3),
+        BASELINE_EPOCH_S,
+        invert=True,
         iter_ms=round(iter_s * 1e3, 2),
         iters_per_epoch=iters_per_epoch,
         batch=args.batch,

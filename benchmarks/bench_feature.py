@@ -30,6 +30,12 @@ def main():
     p.add_argument("--cache-ratio", type=float, default=0.2)
     p.add_argument("--policy", default="replicate", choices=["replicate", "shard"])
     p.add_argument("--gather-batch", type=int, default=65536)
+    p.add_argument(
+        "--kernel",
+        default="auto",
+        choices=["auto", "pallas", "xla"],
+        help="hot-tier gather kernel (auto = pallas on TPU, xla elsewhere)",
+    )
     p.set_defaults(iters=50, warmup=5)
     args = p.parse_args()
 
@@ -45,11 +51,16 @@ def main():
     budget = int(args.cache_ratio * n) * f * 4
 
     if args.policy == "replicate":
-        store = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(feat)
+        store = Feature(
+            device_cache_size=budget, csr_topo=topo, kernel=args.kernel
+        ).from_cpu_tensor(feat)
     else:
         mesh = make_mesh(feature=len(jax.devices()))
         store = ShardedFeature(
-            mesh, device_cache_size=budget // len(jax.devices()), csr_topo=topo
+            mesh,
+            device_cache_size=budget // len(jax.devices()),
+            csr_topo=topo,
+            kernel=args.kernel,
         ).from_cpu_tensor(feat)
     del feat
 
@@ -82,6 +93,7 @@ def main():
         "GB/s",
         BASELINE_GBPS,
         policy=args.policy,
+        kernel=store.kernel,
         cache_ratio=args.cache_ratio,
         gather_batch=args.gather_batch,
     )

@@ -34,20 +34,236 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny graph + few iters: a degraded environment still yields a number",
+    )
+    p.add_argument(
+        "--backend-retries",
+        type=int,
+        default=1,
+        help="extra attempts if the first backend touch fails (transient TPU grab)",
+    )
+    p.add_argument(
+        "--backend-retry-delay",
+        type=float,
+        default=15.0,
+        help="seconds between backend attempts",
+    )
     return p
 
 
-def build_graph(args):
-    """Synthetic products-scale power-law CSRTopo (+ build-time report)."""
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices()[0];"
+    "jnp.zeros(8).block_until_ready();"
+    "print(d.platform, flush=True)"
+)
+
+
+def _probe_subprocess(timeout_s: float):
+    """Touch the backend in a THROWAWAY subprocess first.
+
+    The TPU plugin can hang indefinitely during setup (observed: 10 minutes
+    with no output) — an in-process jax.devices() hang is uninterruptible,
+    so the watchdog must live outside the process. Returns (ok, detail).
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung > {timeout_s:.0f}s (killed)"
+    if r.returncode != 0:
+        return False, (r.stderr or r.stdout).strip()[-500:]
+    return True, r.stdout.strip()
+
+
+def _init_inprocess(timeout_s: float):
+    """In-process backend init under a watchdog thread.
+
+    Even after a successful subprocess probe, another tenant can grab the
+    TPU in the window before our own init — and that hang is indefinite.
+    Returns (device | None, error | None). On timeout the daemon thread is
+    abandoned (it may hold jax's backend lock — the caller must NOT retry
+    backend init in this process; re-exec instead).
+    """
+    import threading
+
+    import jax
+
+    result = {}
+
+    def target():
+        try:
+            result["dev"] = jax.devices()[0]
+        except Exception as e:  # noqa: BLE001 — report any init failure
+            result["err"] = str(e)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None, f"in-process backend init hung > {timeout_s:.0f}s"
+    if "err" in result:
+        return None, result["err"]
+    return result["dev"], None
+
+
+def _reexec_cpu_smoke(reason: str):
+    """Replace this (backend-poisoned) process with a CPU smoke run.
+
+    After an in-process init hang, jax's backend lock may be held by the
+    abandoned thread, so no further jax work is possible here. exec gives a
+    clean interpreter; the degraded reason rides through the environment.
+    """
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["QUIVER_BENCH_DEGRADED"] = reason[:300]
+    # keep the repo root importable: `python -m benchmarks.X` re-execs by
+    # script path (sys.argv[0]), which would otherwise put benchmarks/ on
+    # sys.path instead of the root and break `from benchmarks.common import`
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else repo_root
+    )
+    spec = getattr(sys.modules.get("__main__"), "__spec__", None)
+    if spec is not None and spec.name:
+        argv = [sys.executable, "-m", spec.name] + sys.argv[1:]
+    else:
+        argv = [sys.executable] + sys.argv
+    if "--smoke" not in argv:
+        argv.append("--smoke")
+    log(f"re-exec as CPU smoke run: {' '.join(argv[1:])}")
+    os.execve(sys.executable, argv, env)
+
+
+def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 180.0):
+    """Touch the JAX backend FIRST and fail fast with a diagnostic.
+
+    Round-1 lesson: the harness spent minutes building a 123M-edge graph
+    before the first `jax.devices()` call, then died inside a log f-string
+    when the TPU plugin was unavailable — and the plugin can also HANG
+    instead of erroring. So: (1) probe in a subprocess under a watchdog
+    timeout, retrying for transient TPU-grab races; (2) initialize
+    in-process under its own watchdog; (3) if nothing is usable, either
+    exit nonzero (QUIVER_BENCH_STRICT) or fall back to a clearly-labeled
+    CPU smoke run — always within minutes, never an unbounded hang.
+    """
     import os
 
     import jax
 
-    # honor a JAX_PLATFORMS=cpu request via config (the image's sitecustomize
-    # pins the TPU plugin before env vars are read; backend init is lazy so
-    # this still takes effect — same workaround as tests/conftest.py)
-    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+    global _DEGRADED_REASON
+    if os.environ.get("QUIVER_BENCH_DEGRADED"):
+        # we are the re-exec'd CPU child of a failed accelerator run
+        _DEGRADED_REASON = os.environ["QUIVER_BENCH_DEGRADED"]
+
+    # honor an explicit CPU-only request via config (the image's
+    # sitecustomize pins the TPU plugin before env vars are read; backend
+    # init is lazy so this still takes effect — same workaround as
+    # tests/conftest.py). Exact match only: a priority list like "tpu,cpu"
+    # is NOT a forced-CPU request.
+    plats = [
+        p.strip().lower()
+        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    ]
+    if plats == ["cpu"]:
         jax.config.update("jax_platforms", "cpu")
+        # CPU backend cannot hang; skip the subprocess probe
+        dev = jax.devices()[0]
+        log(f"backend ok: {dev.platform} (forced cpu)")
+        return dev
+
+    last_err = None
+    inproc_hung = False
+    for attempt in range(retries + 1):
+        t0 = time.time()
+        ok, detail = _probe_subprocess(probe_timeout)
+        if ok:
+            log(f"backend probe ok: {detail} ({time.time() - t0:.1f}s)")
+            dev, err = _init_inprocess(probe_timeout)
+            if dev is not None:
+                return dev
+            detail = err
+            inproc_hung = "hung" in (err or "")
+            if inproc_hung:
+                last_err = detail
+                break  # this process can't touch jax again; stop retrying
+        last_err = detail
+        log(f"backend init failed (attempt {attempt + 1}/{retries + 1}): {detail}")
+        if attempt < retries:
+            log(f"retrying in {delay:.0f}s...")
+            time.sleep(delay)
+
+    if os.environ.get("QUIVER_BENCH_STRICT"):
+        log("FATAL: no usable JAX backend (QUIVER_BENCH_STRICT set; no fallback).")
+        print(
+            json.dumps(
+                {
+                    "metric": "backend-init",
+                    "value": None,
+                    "unit": "error",
+                    "vs_baseline": None,
+                    "error": str(last_err)[:500],
+                }
+            )
+        )
+        sys.exit(2)
+
+    # degraded fallback: a clearly-labeled CPU number beats no number
+    # (VERDICT r1 — the round must always produce a measurement)
+    log(
+        "WARNING: accelerator backend unusable; falling back to CPU smoke "
+        "mode. The emitted number is NOT a TPU result. "
+        f"(reason: {str(last_err)[:200]})"
+    )
+    if inproc_hung:
+        _reexec_cpu_smoke(str(last_err))  # never returns
+    jax.config.update("jax_platforms", "cpu")
+    _DEGRADED_REASON = str(last_err)[:300]
+    return jax.devices()[0]
+
+
+# set when init_backend fell back to CPU; emit() stamps it into the JSON
+_DEGRADED_REASON: str | None = None
+
+
+def apply_smoke(args) -> None:
+    """Shrink the workload so a degraded environment still finishes fast."""
+    if getattr(args, "smoke", False):
+        args.nodes = min(args.nodes, 200_000)
+        args.iters = min(args.iters, 5)
+        args.warmup = min(args.warmup, 2)
+        if hasattr(args, "train_nodes"):
+            args.train_nodes = min(args.train_nodes, 20_000)
+        log(f"smoke mode: nodes={args.nodes} iters={args.iters}")
+
+
+def build_graph(args):
+    """Synthetic products-scale power-law CSRTopo (+ build-time report).
+
+    Touches the backend BEFORE the (potentially multi-minute) graph build so
+    backend failures surface in seconds.
+    """
+    init_backend(
+        retries=getattr(args, "backend_retries", 1),
+        delay=getattr(args, "backend_retry_delay", 15.0),
+    )
+    if _DEGRADED_REASON is not None:
+        args.smoke = True  # degraded CPU fallback: shrink to smoke scale
+    apply_smoke(args)
 
     from quiver_tpu import CSRTopo
     from quiver_tpu.utils.graphgen import generate_pareto_graph
@@ -58,7 +274,7 @@ def build_graph(args):
     del ei
     log(
         f"graph: {topo.node_count} nodes, {topo.edge_count} edges "
-        f"({time.time()-t0:.1f}s build); device={jax.devices()[0]}"
+        f"({time.time() - t0:.1f}s build)"
     )
     return topo
 
@@ -67,15 +283,38 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr)
 
 
-def emit(metric: str, value: float, unit: str, baseline: float | None, **extras):
-    """Print the one-line JSON result. ``vs_baseline`` > 1 means better than
-    the reference (for time metrics pass baseline/value via ``invert``)."""
+def emit(
+    metric: str,
+    value: float,
+    unit: str,
+    baseline: float | None,
+    invert: bool = False,
+    **extras,
+):
+    """Print the one-line JSON result. ``vs_baseline`` > 1 always means
+    better than the reference: value/baseline for throughput metrics,
+    baseline/value when ``invert=True`` (time/latency metrics where lower is
+    better)."""
+    if baseline is None:
+        vs = None
+    elif invert:
+        vs = round(baseline / value, 3) if value else None
+    else:
+        vs = round(value / baseline, 3)
     rec = {
         "metric": metric,
         "value": round(value, 3),
         "unit": unit,
-        "vs_baseline": None if baseline is None else round(value / baseline, 3),
+        "vs_baseline": vs,
     }
+    try:
+        import jax
+
+        rec["platform"] = jax.devices()[0].platform
+    except Exception:
+        pass
+    if _DEGRADED_REASON is not None:
+        rec["degraded"] = _DEGRADED_REASON
     rec.update(extras)
     print(json.dumps(rec))
     return rec

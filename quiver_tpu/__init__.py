@@ -65,7 +65,8 @@ __all__ = [
     "reorder_by_degree",
     "show_tensor_info",
     "tensor_info",
-    "Checkpointer",
+    # "Checkpointer" is reachable via lazy __getattr__ but kept out of
+    # __all__: star-import must not require the optional [checkpoint] extra
     "Timer",
     "trace_scope",
     "enable_trace",

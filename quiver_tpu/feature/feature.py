@@ -38,7 +38,63 @@ from ..ops.sample import staged_gather
 from ..utils.reorder import reorder_by_degree
 from ..utils.trace import get_logger, trace_scope
 
-__all__ = ["Feature", "HeteroFeature", "tiered_lookup"]
+__all__ = ["Feature", "HeteroFeature", "tiered_lookup", "resolve_gather_kernel"]
+
+
+def validate_gather_kernel(kernel: str) -> str:
+    """Eager argument check only — MUST NOT touch the JAX backend (object
+    construction must stay cheap and never initialize/lock backend choice)."""
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"kernel must be auto|pallas|xla, got {kernel!r}")
+    return kernel
+
+
+def resolve_gather_kernel(kernel: str) -> str:
+    """Resolve the hot-tier gather kernel choice. Touches the backend, so
+    callers defer this to first use (never the constructor).
+
+    ``"auto"`` picks the Pallas row-DMA kernel (ops/pallas/gather.py — the
+    ``quiver_tensor_gather`` analogue, shard_tensor.cu.hpp:16-58) on TPU,
+    stock XLA take elsewhere (the Pallas interpreter on CPU is correct but
+    slow; XLA's CPU gather is fine).
+    """
+    validate_gather_kernel(kernel)
+    if kernel == "auto":
+        try:
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        except RuntimeError:
+            return "xla"
+    return kernel
+
+
+def _hot_gather_fn(table, kernel: str):
+    """(ids) -> rows gather over the HBM-resident hot tier."""
+    if kernel == "pallas":
+        from ..ops.pallas.gather import gather_rows
+
+        return lambda ids: gather_rows(table, ids.astype(jnp.int32))
+    return lambda ids: table[ids]
+
+
+class KernelChoice:
+    """Lazy, retrace-stable gather-kernel choice for the feature stores.
+
+    ``self._kernel`` holds the constructor request verbatim (it rides in
+    pytree aux_data, so it must NEVER change — mutating it after a jit call
+    would silently invalidate the jit cache and force a retrace); the
+    resolved choice is cached separately. Resolution touches the backend,
+    so it happens on first use, never in a constructor.
+    """
+
+    _kernel: str
+
+    @property
+    def kernel(self) -> str:
+        resolved = getattr(self, "_kernel_resolved", None)
+        if resolved is None:
+            resolved = resolve_gather_kernel(self._kernel)
+            self._kernel_resolved = resolved
+        return resolved
 
 
 def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather):
@@ -66,7 +122,7 @@ def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather):
 
 
 @jax.tree_util.register_pytree_node_class
-class Feature:
+class Feature(KernelChoice):
     """Tiered node-feature table with jit-compatible lookup.
 
     Args mirror the reference's constructor (feature.py:29-44):
@@ -83,6 +139,7 @@ class Feature:
         cache_policy: str | CachePolicy = CachePolicy.DEVICE_REPLICATE,
         csr_topo: CSRTopo | None = None,
         hot_shuffle_seed: int = 0,
+        kernel: str = "auto",
     ):
         self.rank = rank
         self.device_list = device_list or [0]
@@ -90,6 +147,7 @@ class Feature:
         self.cache_policy = CachePolicy.parse(cache_policy)
         self.csr_topo = csr_topo
         self.hot_shuffle_seed = hot_shuffle_seed
+        self._kernel = validate_gather_kernel(kernel)
         # populated by from_cpu_tensor
         self.hot = None
         self.cold = None
@@ -151,7 +209,7 @@ class Feature:
 
         Jit-composable; invalid lanes return zero rows.
         """
-        hot_gather = None if self.hot is None else lambda ids: self.hot[ids]
+        hot_gather = None if self.hot is None else _hot_gather_fn(self.hot, self.kernel)
         cold_gather = (
             None
             if self.cold is None
@@ -183,6 +241,7 @@ class Feature:
             self.dtype,
             self._cold_is_host,
             self.hot_shuffle_seed,
+            self._kernel,
         )
         return children, aux
 
@@ -200,6 +259,7 @@ class Feature:
             obj.dtype,
             obj._cold_is_host,
             obj.hot_shuffle_seed,
+            obj._kernel,
         ) = aux
         obj.device_list = list(device_list)
         obj.csr_topo = None

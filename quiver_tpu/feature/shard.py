@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.config import CachePolicy, parse_size_bytes
-from .feature import tiered_lookup
+from .feature import (
+    KernelChoice,
+    _hot_gather_fn,
+    tiered_lookup,
+    validate_gather_kernel,
+)
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
 from ..ops.sample import staged_gather
@@ -40,7 +45,7 @@ from ..utils.reorder import reorder_by_degree
 __all__ = ["ShardedTensor", "ShardedFeature"]
 
 
-class ShardedTensor:
+class ShardedTensor(KernelChoice):
     """2-D table row-sharded over the mesh's feature axis.
 
     Rows are padded to a multiple of the axis size; shard d owns rows
@@ -49,10 +54,11 @@ class ShardedTensor:
     (shard_tensor.py:55-76).
     """
 
-    def __init__(self, mesh: Mesh, axis: str = FEATURE_AXIS):
+    def __init__(self, mesh: Mesh, axis: str = FEATURE_AXIS, kernel: str = "auto"):
         self.mesh = mesh
         self.axis = axis
         self.num_shards = mesh.shape[axis]
+        self._kernel = validate_gather_kernel(kernel)
         self.table = None
         self.rows_per_shard = 0
         self.num_rows = 0
@@ -86,7 +92,7 @@ class ShardedTensor:
         owner = ids // self.rows_per_shard
         mine = owner == my
         local_idx = jnp.where(mine, ids - my * self.rows_per_shard, 0)
-        rows = local_table[local_idx]
+        rows = _hot_gather_fn(local_table, self.kernel)(local_idx)
         return jnp.where(mine[:, None], rows, 0)
 
     def _gather_fn(self, padded_len: int, dtype):
@@ -137,7 +143,7 @@ class ShardedTensor:
         return out[:n] if pad else out
 
 
-class ShardedFeature:
+class ShardedFeature(KernelChoice):
     """Feature store with mesh-sharded hot tier + host cold tier.
 
     The MESH_SHARD realization of the reference's ``p2p_clique_replicate``
@@ -153,9 +159,11 @@ class ShardedFeature:
         csr_topo: CSRTopo | None = None,
         axis: str = FEATURE_AXIS,
         hot_shuffle_seed: int = 0,
+        kernel: str = "auto",
     ):
         self.mesh = mesh
         self.axis = axis
+        self._kernel = validate_gather_kernel(kernel)
         self.cache_policy = CachePolicy.MESH_SHARD
         self.cache_budget = parse_size_bytes(device_cache_size)
         self.csr_topo = csr_topo
@@ -188,9 +196,9 @@ class ShardedFeature:
         self.dtype = tensor.dtype
         self.hot_rows = int(hot_rows)
         if hot_rows > 0:
-            self.hot = ShardedTensor(self.mesh, self.axis).from_cpu_tensor(
-                tensor[:hot_rows]
-            )
+            self.hot = ShardedTensor(
+                self.mesh, self.axis, kernel=self._kernel
+            ).from_cpu_tensor(tensor[:hot_rows])
         if hot_rows < n:
             self.cold, self._cold_is_host = to_pinned_host(
                 tensor[hot_rows:], mesh=self.mesh

@@ -232,7 +232,11 @@ int64_t reindex_cpu(const int32_t* seeds, int64_t n_seeds,
   first.reserve((size_t)(n_seeds * (k + 1)));
   int64_t m = 0;
   // forced seed lanes: every valid seed occupies its own slot; the map keeps
-  // the FIRST occurrence so later duplicates resolve to it
+  // the FIRST occurrence so later duplicates resolve to it. Intentional
+  // divergence from the reference's CPUQuiver::reindex_group (quiver.cpp:56),
+  // which overwrites so duplicate seeds map to the LAST slot — this repo's
+  // first-occurrence rule matches its own XLA reindex_layer (masked_unique),
+  // which is what this path is differential-tested against.
   for (int64_t i = 0; i < n_seeds; ++i) {
     int32_t s = seeds[i];
     if (s < 0) continue;

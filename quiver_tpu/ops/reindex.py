@@ -15,7 +15,40 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["masked_unique", "reindex_layer"]
+__all__ = [
+    "masked_unique",
+    "reindex_layer",
+    "inverse_permutation",
+    "complete_permutation",
+]
+
+
+def inverse_permutation(p):
+    """q with q[p[i]] == i — the reference's ``inverse_permutation``
+    (reindex.cu.hpp:304-315), as one XLA scatter instead of a thrust
+    for_each."""
+    n = p.shape[0]
+    return jnp.zeros(n, p.dtype).at[p].set(jnp.arange(n, dtype=p.dtype))
+
+
+def complete_permutation(p, n: int):
+    """Extend an injective partial map ``p`` (m distinct values < n) to a
+    full permutation of {0..n-1}: p's entries first (in order), then the
+    missing values ascending — the reference's ``complete_permutation``
+    (reindex.cu.hpp:277-300, pair-sort construction). Static-shape rebuild:
+    rank present values by position in p, absent values by value after all
+    present ones, then argsort the rank vector.
+    """
+    m = p.shape[0]
+    if m > n:
+        raise ValueError(f"partial permutation longer ({m}) than n ({n})")
+    # rank[v] = position in p when present, m + v when absent — absent
+    # values compare after every present one yet stay value-ordered.
+    # (m + v fits: m <= n and v < n, so rank < 2n < int32 max for any
+    # realistic graph.)
+    vals = jnp.arange(n, dtype=p.dtype)
+    rank = (vals + m).at[p].set(jnp.arange(m, dtype=p.dtype))
+    return jnp.argsort(rank).astype(p.dtype)
 
 
 def masked_unique(ids, valid, size: int, num_forced: int = 0):

@@ -83,16 +83,24 @@ def _round_up(x: int, m: int) -> int:
 
 
 def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
-                      kernel="xla"):
+                      kernel="xla", with_eid=False):
     """The multi-layer sample+reindex loop (jit- and shard_map-composable).
 
     One trace covers all layers — the fused analogue of the reference's
     per-hop Python loop of C++ calls (sage_sampler.py:84-112). Shapes are
     fully static: ``sizes`` and ``caps`` are tuples of ints.
 
+    With ``with_eid`` each Adj carries per-edge global edge ids aligned with
+    its edge_index columns (-1 on invalid lanes) — the reference's per-hop
+    ``e_id`` output (sage_sampler.py:100-109, reindex_single eid plumbing).
+    Ids are original COO edge positions when the topology tracks ``eid``,
+    raw CSR slots otherwise.
+
     Returns (n_id, n_count, adjs deepest-first, overflow, per-layer edge
     counts, per-layer unclipped frontier counts).
     """
+    if with_eid and kernel == "pallas":
+        raise ValueError("kernel='pallas' does not support with_eid")
     adjs = []
     edge_counts = []
     frontier_counts = []
@@ -100,6 +108,7 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
     total_overflow = jnp.zeros((), jnp.int32)
     for l, k in enumerate(sizes):
         key, sub = jax.random.split(key)
+        eids = None
         with trace_scope(f"sample_layer_{l}"):
             if kernel == "pallas":
                 if weighted:
@@ -117,6 +126,9 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
                     nbr, counts = sample_layer_windowed(topo, cur, cur_n, k, sub)
                 else:
                     nbr, counts = sample_layer(topo, cur, cur_n, k, sub)
+            elif with_eid:
+                nbr, counts, eids = sample_layer(topo, cur, cur_n, k, sub,
+                                                 weighted=weighted, with_eid=True)
             else:
                 nbr, counts = sample_layer(topo, cur, cur_n, k, sub,
                                            weighted=weighted)
@@ -126,7 +138,11 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
         row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
         row = jnp.where(col >= 0, row, -1)
         edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
-        adjs.append(Adj(edge_index, None, (caps[l], S)))
+        if eids is not None:
+            # re-mask with col: neighbors dropped by frontier-cap overflow
+            # must not leak their edge ids
+            eids = jnp.where(col >= 0, eids, -1).reshape(-1)
+        adjs.append(Adj(edge_index, eids, (caps[l], S)))
         # per-layer tallies in-program: benchmarks and the auto-cap planner
         # read scalars instead of reducing (2, E_cap) arrays on the host
         # path. Tallied POST-reindex (col >= 0), so overflow-dropped
@@ -164,6 +180,9 @@ class GraphSageSampler:
       kernel: "xla" (exact stratified sampler) or "pallas" (windowed-DMA
         Pallas kernel, ops/pallas/sample.py — HBM mode, unweighted only;
         near-identical distribution, see the kernel's module docstring).
+      with_eid: populate ``Adj.e_id`` with per-edge global edge ids
+        (reference sage_sampler.py:100-109) — COO positions when the
+        topology tracks ``eid``, CSR slots otherwise. XLA kernel only.
     """
 
     def __init__(
@@ -178,6 +197,7 @@ class GraphSageSampler:
         weighted: bool = False,
         auto_margin: float = 1.25,
         kernel: str = "xla",
+        with_eid: bool = False,
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -186,12 +206,15 @@ class GraphSageSampler:
         if any(k < 1 for k in self.sizes):
             raise ValueError(f"fanouts must be >= 1 or -1, got {sizes}")
         self.weighted = bool(weighted)
+        self.with_eid = bool(with_eid)
         self.kernel = str(kernel)
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
         if self.kernel == "pallas":
             if weighted:
                 raise ValueError("kernel='pallas' supports unweighted sampling only")
+            if self.with_eid:
+                raise ValueError("kernel='pallas' does not support with_eid")
             if SampleMode.parse(mode) is not SampleMode.HBM:
                 raise ValueError("kernel='pallas' requires mode='HBM' (GPU) topology")
         if self.weighted and csr_topo.cum_weights is None:
@@ -199,7 +222,9 @@ class GraphSageSampler:
                 "weighted=True requires edge weights; call "
                 "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
             )
-        self.topo = csr_topo.to_device(self.mode, with_weights=self.weighted)
+        self.topo = csr_topo.to_device(
+            self.mode, with_eid=self.with_eid, with_weights=self.weighted
+        )
         self._seed_capacity = seed_capacity
         self._auto_caps = frontier_caps == "auto"
         self._auto_margin = float(auto_margin)
@@ -267,11 +292,13 @@ class GraphSageSampler:
         sizes = self.sizes
         weighted = self.weighted
         kernel = self.kernel
+        with_eid = self.with_eid
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
             return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
-                                     weighted=weighted, kernel=kernel)
+                                     weighted=weighted, kernel=kernel,
+                                     with_eid=with_eid)
 
         self._compiled_cache[cache_key] = (run, caps)
         return run, caps

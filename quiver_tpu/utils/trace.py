@@ -101,16 +101,26 @@ class Timer:
 
 
 def get_logger(child: str | None = None) -> logging.Logger:
-    """The package logger (replaces the reference's LOG>>> prints)."""
+    """The package logger (replaces the reference's LOG>>> prints).
+
+    Library-friendly by default: a NullHandler with propagation left on, so
+    applications route/format quiver_tpu records through their own logging
+    config. Set ``QUIVER_LOG_LEVEL`` (e.g. INFO) to opt into a ready-made
+    stderr handler for scripts/benchmarks.
+    """
     logger = logging.getLogger("quiver_tpu")
     if not logger.handlers:
-        h = logging.StreamHandler()
-        h.setFormatter(
-            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
-        )
-        logger.addHandler(h)
-        logger.setLevel(os.environ.get("QUIVER_LOG_LEVEL", "INFO"))
-        logger.propagate = False
+        level = os.environ.get("QUIVER_LOG_LEVEL")
+        if level:
+            h = logging.StreamHandler()
+            h.setFormatter(
+                logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+            )
+            logger.addHandler(h)
+            logger.setLevel(level)
+            logger.propagate = False
+        else:
+            logger.addHandler(logging.NullHandler())
     return logger.getChild(child) if child else logger
 
 

@@ -99,3 +99,27 @@ def test_feature_delete_frees_buffers():
     assert f.hot is None and f.cold is None and f.hot_rows == 0
     with _pytest.raises(RuntimeError):
         _ = np.asarray(hot)  # buffer really gone
+
+
+def test_pallas_kernel_switch_matches_xla():
+    """VERDICT r1 item 2: the Pallas gather must be reachable through the
+    Feature store, not just as a dangling unit-tested kernel. Differential
+    oracle: kernel="pallas" (interpret mode on CPU) == kernel="xla" == dense
+    take, including the mixed hot/cold tier split and -1 lanes."""
+    t = _table(n=300, f=16, seed=3)
+    row_bytes = 16 * 4
+    ids = jnp.asarray(
+        np.concatenate([np.random.default_rng(4).integers(0, 300, 60), [-1, -1]])
+    )
+    fx = Feature(device_cache_size=100 * row_bytes, kernel="xla").from_cpu_tensor(t)
+    fp = Feature(device_cache_size=100 * row_bytes, kernel="pallas").from_cpu_tensor(t)
+    assert fx.kernel == "xla" and fp.kernel == "pallas"
+    ox, op = np.asarray(fx[ids]), np.asarray(fp[ids])
+    assert np.allclose(ox, op)
+    assert np.allclose(op[:60], t[np.asarray(ids)[:60]])
+    assert np.all(op[60:] == 0)
+
+
+def test_kernel_auto_resolves_off_tpu():
+    f = Feature(device_cache_size="1G", kernel="auto")
+    assert f.kernel == "xla"  # CPU test mesh — pallas only auto-selected on TPU

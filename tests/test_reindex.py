@@ -98,3 +98,41 @@ def test_reindex_layer_matches_reference():
     assert np.array_equal(np.asarray(col), ref_col)
     # seeds-first contract: frontier[:num_seeds] == seeds
     assert np.array_equal(np.asarray(frontier[:num_seeds]), seeds[:num_seeds])
+
+
+def test_inverse_permutation_property():
+    """Reference test_reindex.cu:187-247 analogue: q[p[i]] == i across sizes."""
+    from quiver_tpu.ops.reindex import inverse_permutation
+
+    for n in (1, 5, 100, 10000):
+        p = np.random.default_rng(n).permutation(n).astype(np.int32)
+        q = np.asarray(inverse_permutation(jnp.asarray(p)))
+        assert np.array_equal(q[p], np.arange(n))
+        # inverse of inverse is the original
+        assert np.array_equal(
+            np.asarray(inverse_permutation(jnp.asarray(q))), p
+        )
+
+
+def test_complete_permutation_property():
+    """Partial prefix preserved verbatim; missing values appended ascending;
+    result is a permutation (reference complete_permutation semantics,
+    reindex.cu.hpp:277-300)."""
+    from quiver_tpu.ops.reindex import complete_permutation
+
+    rng = np.random.default_rng(0)
+    for n, m in ((5, 3), (100, 40), (10000, 1234), (64, 0), (64, 64)):
+        p = rng.permutation(n)[:m].astype(np.int32)
+        full = np.asarray(complete_permutation(jnp.asarray(p), n))
+        assert np.array_equal(np.sort(full), np.arange(n))  # is a permutation
+        assert np.array_equal(full[:m], p)  # prefix preserved
+        missing = np.setdiff1d(np.arange(n), p)
+        assert np.array_equal(full[m:], missing)  # ascending completion
+
+
+def test_complete_permutation_rejects_overlong():
+    import pytest
+    from quiver_tpu.ops.reindex import complete_permutation
+
+    with pytest.raises(ValueError, match="longer"):
+        complete_permutation(jnp.arange(10, dtype=jnp.int32), 5)
