@@ -29,6 +29,12 @@ def main():
     p.add_argument("--classes", type=int, default=47)
     p.add_argument("--cache-ratio", type=float, default=0.2)
     p.add_argument("--model", default="sage", choices=["sage", "gat"])
+    p.add_argument(
+        "--mode",
+        default="HBM",
+        choices=["HBM", "HOST", "GPU", "UVA"],
+        help="topology placement: HBM-resident or beyond-HBM host staging",
+    )
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--train-nodes", type=int, default=PRODUCTS_TRAIN_NODES)
     p.set_defaults(batch=1024, iters=40, warmup=3)
@@ -53,8 +59,8 @@ def main():
     # the deepest n_id is worst-case-padded and the feature gather + model
     # aggregate run ~3x wider than needed (SURVEY §7.4.2)
     sampler = GraphSageSampler(
-        topo, args.fanout, seed_capacity=args.batch, seed=args.seed,
-        frontier_caps="auto",
+        topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
+        seed=args.seed, frontier_caps="auto",
     )
     labels_all = jnp.asarray(
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)
@@ -122,6 +128,7 @@ def main():
         iters_per_epoch=iters_per_epoch,
         batch=args.batch,
         model=args.model,
+        mode=args.mode,
         final_loss=round(float(loss), 4),
     )
 

@@ -25,6 +25,13 @@ def main():
     p = base_parser(__doc__)
     p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
     p.add_argument("--mode", default="HBM", choices=["HBM", "HOST", "GPU", "UVA"])
+    p.add_argument(
+        "--kernel",
+        default="xla",
+        choices=["xla", "pallas"],
+        help="sampling kernel: exact XLA stratified sampler or the Pallas "
+        "windowed-DMA kernel (HBM mode, unweighted)",
+    )
     p.set_defaults(warmup=25, iters=50)
     args = p.parse_args()
 
@@ -34,7 +41,8 @@ def main():
 
     topo = build_graph(args)
     sampler = GraphSageSampler(
-        topo, args.fanout, mode=args.mode, seed_capacity=args.batch, seed=args.seed
+        topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
+        seed=args.seed, kernel=args.kernel,
     )
     rng = np.random.default_rng(args.seed)
 
@@ -59,6 +67,7 @@ def main():
         "SEPS",
         BASELINE_UVA_SEPS,
         mode=args.mode,
+        kernel=args.kernel,
         fanout=args.fanout,
         batch=args.batch,
     )

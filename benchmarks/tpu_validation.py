@@ -1,0 +1,176 @@
+"""On-TPU kernel validation + head-to-head benchmarks (VERDICT r1 items 2-3).
+
+Runs the Pallas kernels COMPILED on real TPU (CPU tests only ever interpret
+them) and holds them to the same oracles as the XLA paths:
+
+1. windowed Pallas sampler: validity oracle (membership / counts /
+   per-row distinctness) + inclusion-frequency test on device;
+2. Pallas row-gather: differential vs dense take;
+3. SEPS head-to-head, Pallas vs XLA sampler, across fanouts;
+4. feature GB/s head-to-head, Pallas vs XLA gather.
+
+Prints one JSON line per measurement (benchmarks/common.py schema) so the
+results can be pasted into docs verbatim.
+
+    python -m benchmarks.tpu_validation            # full run (needs TPU)
+    python -m benchmarks.tpu_validation --smoke    # small shapes
+"""
+
+import time
+
+import numpy as np
+
+from benchmarks.common import apply_smoke, base_parser, emit, init_backend, log
+
+
+def validate_sampler_correctness(topo, dev, fanout, batch, seed):
+    """Validity oracle on compiled-Pallas output (tests/test_pallas.py
+    invariants, run on device instead of interpret mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.pallas.sample import sample_layer_windowed
+
+    indptr, indices = topo.indptr, topo.indices
+    seeds = np.random.default_rng(seed).integers(
+        0, topo.node_count, batch
+    ).astype(np.int32)
+    nbr, counts = sample_layer_windowed(
+        dev, jnp.asarray(seeds), jnp.int32(batch), fanout, jax.random.PRNGKey(seed)
+    )
+    nbr, counts = np.asarray(nbr), np.asarray(counts)
+    bad = 0
+    for r in range(batch):
+        s = seeds[r]
+        row = set(indices[indptr[s]:indptr[s + 1]].tolist())
+        deg = indptr[s + 1] - indptr[s]
+        got = nbr[r][nbr[r] >= 0]
+        ok = (
+            counts[r] == min(deg, fanout)
+            and len(got) == counts[r]
+            and set(got.tolist()) <= row
+        )
+        bad += not ok
+    return bad
+
+
+def frequency_test(topo, dev, fanout, trials, seed):
+    """Inclusion frequencies of one high-degree row's neighbors must be
+    ~uniform (the windowed kernel is distribution-approximate for
+    deg > window; measure the deviation instead of assuming)."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.pallas.sample import sample_layer_windowed
+
+    deg = np.diff(topo.indptr)
+    row = int(np.argmax(deg))  # hottest row
+    d = int(deg[row])
+    seeds = jnp.full(128, row, jnp.int32)
+    hits = np.zeros(d, np.int64)
+    base = topo.indptr[row]
+    pos_of = {int(v): i for i, v in enumerate(topo.indices[base:base + d])}
+    for t in range(trials):
+        nbr, _ = sample_layer_windowed(
+            dev, seeds, jnp.int32(128), fanout, jax.random.PRNGKey(1000 + t)
+        )
+        got = np.asarray(nbr).reshape(-1)
+        for v in got[got >= 0]:
+            hits[pos_of[int(v)]] += 1
+    expected = hits.sum() / d
+    rel_dev = float(np.abs(hits - expected).max() / max(expected, 1))
+    return d, rel_dev
+
+
+def bench_seps(sampler_cls, topo, fanouts, batch, iters, seed, kernel):
+    import jax
+
+    sampler = sampler_cls(
+        topo, fanouts, seed_capacity=batch, seed=seed, kernel=kernel
+    )
+    rng = np.random.default_rng(seed)
+    for _ in range(10):
+        out = sampler.sample(rng.integers(0, topo.node_count, batch))
+    jax.block_until_ready(out.n_id)
+    total = 0
+    t0 = time.time()
+    for _ in range(iters):
+        out = sampler.sample(rng.integers(0, topo.node_count, batch))
+        total += int(sum(out.edge_counts))
+    jax.block_until_ready(out.n_id)
+    return total / (time.time() - t0)
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
+    p.add_argument("--trials", type=int, default=50)
+    p.set_defaults(nodes=500_000, iters=30)
+    args = p.parse_args()
+
+    dev0 = init_backend(retries=getattr(args, "backend_retries", 1))
+    apply_smoke(args)
+    on_tpu = dev0.platform == "tpu"
+    if not on_tpu:
+        log("WARNING: not on TPU — Pallas runs in interpret mode; numbers "
+            "are NOT hardware results")
+
+    import jax
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.ops.pallas.gather import gather_rows
+    from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+    t0 = time.time()
+    ei = generate_pareto_graph(args.nodes, args.avg_degree, seed=args.seed)
+    topo = CSRTopo(edge_index=ei)
+    del ei
+    dev = topo.to_device()
+    log(f"graph: {topo.node_count} nodes, {topo.edge_count} edges "
+        f"({time.time() - t0:.1f}s)")
+
+    # 1. compiled-sampler correctness
+    bad = validate_sampler_correctness(topo, dev, 10, 256, args.seed)
+    emit("pallas-sampler-invalid-rows", bad, "rows", None, batch=256, fanout=10)
+
+    # 2. frequency deviation on the hottest row
+    d, rel_dev = frequency_test(topo, dev, 8, min(args.trials, 50), args.seed)
+    emit("pallas-sampler-freq-reldev", rel_dev, "ratio", None, row_degree=d)
+
+    # 3. SEPS head-to-head
+    import jax.numpy as jnp
+
+    for kernel in ("xla", "pallas"):
+        seps = bench_seps(
+            GraphSageSampler, topo, args.fanout, args.batch, args.iters,
+            args.seed, kernel,
+        )
+        emit("sampler-seps", seps, "SEPS", 34.29e6, kernel=kernel,
+             fanout=args.fanout, batch=args.batch)
+
+    # 4. gather GB/s head-to-head
+    n_rows = min(topo.node_count, 1_000_000)
+    table = jnp.asarray(
+        np.random.default_rng(0).normal(size=(n_rows, 128)).astype(np.float32)
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, n_rows, 65536), jnp.int32
+    )
+    for name, fn in (
+        ("xla", lambda: table[ids]),
+        ("pallas", lambda: gather_rows(table, ids)),
+    ):
+        jax.block_until_ready(fn())
+        t0 = time.time()
+        reps = 50
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        gbps = reps * out.size * out.dtype.itemsize / dt / 1e9
+        emit("gather-GBps", gbps, "GB/s", 14.82, kernel=name,
+             rows=int(ids.shape[0]), feature_dim=128)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,14 +2,22 @@
 
 Parity with the reference's canonical example (torch-quiver
 examples/pyg/reddit_quiver.py): build topology, a [25,10] neighbor sampler,
-a 20%-cached feature store, a 2-layer SAGE model, and train with the
+a 20%-cached feature store, a 2-layer SAGE model, train with the
 "Epoch xx, Loss ..., Approx. Train Acc ..." progress line (README.md:76-78
-success criterion). Runs on a synthetic Reddit-scale power-law graph so no
-dataset download is needed; point --nodes/--avg-degree at your own scale or
-load a real graph with CSRTopo(edge_index=...).
+success criterion), then report held-out test accuracy.
 
-    python -m examples.train_sage                  # Reddit scale (~20s/epoch compile+run)
-    python -m examples.train_sage --nodes 20000 --avg-degree 12 --epochs 2   # smoke
+Datasets (quiver_tpu.datasets):
+    --dataset synthetic            random power-law graph, random labels
+                                   (throughput exercise; accuracy ~1/C)
+    --dataset planted[:n[:C]]      stochastic-block-model acceptance graph —
+                                   test accuracy must clear feature-only
+                                   Bayes by a wide margin
+    --dataset reddit --root DIR    PyG Reddit npz layout (reference's
+                                   reddit_quiver.py workload; expect ~0.93+)
+    --dataset ogbn-products --root DIR   OGB raw CSV layout
+
+    python -m examples.train_sage --dataset planted:20000 --epochs 4
+    python -m examples.train_sage --dataset reddit --root /data/Reddit/raw
 """
 
 import argparse
@@ -22,13 +30,52 @@ import jax.numpy as jnp
 import optax
 
 from quiver_tpu import CSRTopo, Feature, GraphSageSampler
+from quiver_tpu.datasets import GraphDataset, load_dataset
 from quiver_tpu.models.sage import GraphSAGE
 from quiver_tpu.parallel.train import make_eval_step, make_train_step
 from quiver_tpu.utils.graphgen import generate_pareto_graph
 
 
-def main():
+def synthetic_dataset(args) -> GraphDataset:
+    rng = np.random.default_rng(args.seed)
+    topo = CSRTopo(
+        edge_index=generate_pareto_graph(args.nodes, args.avg_degree, seed=args.seed)
+    )
+    n = topo.node_count
+    labels = rng.integers(0, args.classes, n).astype(np.int32)
+    feat = rng.normal(size=(n, args.feature_dim)).astype(np.float32)
+    perm = rng.permutation(n)
+    return GraphDataset(
+        name="synthetic", topo=topo, features=feat, labels=labels,
+        train_idx=perm[: n // 10], val_idx=perm[n // 10 : n // 5],
+        test_idx=perm[n // 5 : n // 2], num_classes=args.classes,
+    )
+
+
+def evaluate(sampler, feature, eval_step, params, labels_all, idx, batch):
+    """Batched accuracy over a node-id split (reference test() loop parity)."""
+    correct = total = 0
+    for lo in range(0, len(idx), batch):
+        seeds = idx[lo : lo + batch]
+        out = sampler.sample(seeds)
+        x = feature[out.n_id]
+        # logits span the padded seed capacity; lanes past batch_size hold
+        # frontier nodes (not -1), so mask by the true batch size
+        cap = out.adjs[-1].size[1]
+        seed_ids = out.n_id[:cap]
+        labels = labels_all[jnp.clip(seed_ids, 0)]
+        mask = (jnp.arange(cap) < out.batch_size) & (seed_ids >= 0)
+        c, t = eval_step(params, x, out.adjs, labels, mask)
+        correct += int(c)
+        total += int(t)
+    return correct / max(total, 1)
+
+
+def main(argv=None):
     p = argparse.ArgumentParser()
+    p.add_argument("--dataset", default="synthetic",
+                   help="synthetic | planted[:n[:C]] | reddit | ogbn-* ")
+    p.add_argument("--root", default=None, help="on-disk dataset directory")
     p.add_argument("--nodes", type=int, default=232_965)  # Reddit scale
     p.add_argument("--avg-degree", type=float, default=100.0)
     p.add_argument("--feature-dim", type=int, default=602)  # Reddit: 602
@@ -40,25 +87,31 @@ def main():
     p.add_argument("--cache-ratio", type=float, default=0.2)
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--seed", type=int, default=0)
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
-    rng = np.random.default_rng(args.seed)
-    print(f"building synthetic graph ({args.nodes} nodes)...")
-    topo = CSRTopo(edge_index=generate_pareto_graph(args.nodes, args.avg_degree,
-                                                    seed=args.seed))
-    n = topo.node_count
+    if args.dataset == "synthetic":
+        ds = synthetic_dataset(args)
+    else:
+        ds = load_dataset(args.dataset, root=args.root)
+    topo, n = ds.topo, ds.node_count
+    print(f"{ds.name}: {n} nodes, {topo.edge_count} edges, "
+          f"{ds.feature_dim} features, {ds.num_classes} classes, "
+          f"{len(ds.train_idx)} train / {len(ds.test_idx)} test")
 
     # quiver.Feature equivalent: degree-ordered 20% HBM cache, cold rows on host
-    feat = rng.normal(size=(n, args.feature_dim)).astype(np.float32)
-    budget = int(args.cache_ratio * n) * args.feature_dim * 4
-    feature = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(feat)
-    del feat
-    labels_all = jnp.asarray(rng.integers(0, args.classes, n).astype(np.int32))
-    train_idx = rng.permutation(n)[: max(args.batch, n // 10)]
+    budget = int(args.cache_ratio * n) * ds.feature_dim * 4
+    feature = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(
+        ds.features
+    )
+    # drop the source array: the tiered store holds the only copy now
+    # (for Reddit/products scale this halves peak host memory)
+    ds = ds._replace(features=None)
+    labels_all = jnp.asarray(ds.labels)
+    train_idx = np.asarray(ds.train_idx)
 
     sampler = GraphSageSampler(topo, args.fanout, seed_capacity=args.batch,
                                seed=args.seed, frontier_caps="auto")
-    model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
+    model = GraphSAGE(hidden=args.hidden, num_classes=ds.num_classes,
                       num_layers=len(args.fanout))
     tx = optax.adam(args.lr)
     train_step = jax.jit(make_train_step(model, tx))
@@ -95,6 +148,16 @@ def main():
             f"Approx. Train Acc: {correct / max(total, 1):.4f} "
             f"({time.time() - t0:.1f}s)"
         )
+
+    test_acc = evaluate(
+        sampler, feature, eval_step, params, labels_all, np.asarray(ds.test_idx),
+        args.batch,
+    )
+    line = f"Test Acc: {test_acc:.4f}"
+    if "feature_bayes_acc" in ds.meta:
+        line += f" (feature-only Bayes: {ds.meta['feature_bayes_acc']:.4f})"
+    print(line)
+    return test_acc, ds
 
 
 if __name__ == "__main__":

@@ -12,12 +12,14 @@ Top-level exports mirror the reference package surface
 """
 
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
+from .datasets import GraphDataset, load_dataset, planted_partition
 from .core.hetero import HeteroCSRTopo, RelCSR
 from .core.topology import CSRTopo, DeviceTopology
 from .feature.feature import Feature, HeteroFeature
 from .feature.shard import ShardedFeature, ShardedTensor
 from .parallel.mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
 from .parallel.pipeline import Batch, Prefetcher
+from .parallel.trainer import DataParallelTrainer, DistributedTrainer
 from .sampling.hetero import HeteroGraphSampler, HeteroSampleOutput
 from .sampling.saint import (
     SAINTEdgeSampler,
@@ -56,12 +58,17 @@ __all__ = [
     "p2pCliqueTopo",
     "Batch",
     "Prefetcher",
+    "DataParallelTrainer",
+    "DistributedTrainer",
     "make_mesh",
     "init_p2p",
     "can_device_access_peer",
     "CachePolicy",
     "SampleMode",
     "parse_size_bytes",
+    "GraphDataset",
+    "load_dataset",
+    "planted_partition",
     "reorder_by_degree",
     "show_tensor_info",
     "tensor_info",

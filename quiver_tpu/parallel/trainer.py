@@ -34,10 +34,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..feature.feature import Feature
 from ..feature.shard import ShardedFeature
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
+from ..parallel.pipeline import Prefetcher
 from ..parallel.train import cross_entropy_on_seeds
-from ..sampling.sampler import GraphSageSampler, multilayer_sample
+from ..sampling.sampler import Adj, GraphSageSampler, multilayer_sample
 
-__all__ = ["DistributedTrainer"]
+__all__ = ["DistributedTrainer", "DataParallelTrainer"]
 
 
 class DistributedTrainer:
@@ -199,3 +200,231 @@ class DistributedTrainer:
         return self._step(
             params, opt_state, self.sampler.topo, hot, packed, labels, key
         )
+
+
+class DataParallelTrainer:
+    """Multi-chip training for beyond-HBM configurations — the papers100M path.
+
+    The fused :class:`DistributedTrainer` requires everything device-resident;
+    this trainer is its *unfused* sibling for HOST-mode topologies and
+    cold-tier features, mirroring the reference's flagship scale architecture
+    exactly (benchmarks/ogbn-papers100M/dist_sampling_ogb_paper100M_quiver.py:
+    120-165): each data-parallel worker samples its own seed block and
+    gathers its own features (here: the single-controller sample/gather
+    paths, which already stage host-resident topology and cold-tier rows
+    through host compute), and only the model step runs as one SPMD program —
+    a shard_map over the ``data`` axis with a gradient ``pmean``, the
+    reference's DDP/NCCL allreduce (:133). :class:`Prefetcher` overlap makes
+    batch i+1's sample+gather run under batch i's step — the role UVA's
+    "kernel reads host RAM while computing" plays in the reference.
+
+    Accepts ANY sampler/feature configuration (mode="HOST", cold tiers,
+    weighted, auto caps); the feature store must be a replicated
+    :class:`Feature` (the reference's papers100M config is device_replicate
+    too; mesh-sharded hot tiers belong to the fused trainer).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        sampler: GraphSageSampler,
+        feature: Feature,
+        model,
+        tx: optax.GradientTransformation,
+        local_batch: int = 128,
+    ):
+        if isinstance(feature, ShardedFeature):
+            raise ValueError(
+                "DataParallelTrainer replicates the feature store; use the "
+                "fused DistributedTrainer for mesh-sharded hot tiers"
+            )
+        if mesh.shape.get(FEATURE_AXIS, 1) != 1:
+            raise ValueError(
+                "DataParallelTrainer is pure data parallelism; build the "
+                "mesh with feature=1"
+            )
+        self.mesh = mesh
+        self.sampler = sampler
+        self.feature = feature
+        self.model = model
+        self.tx = tx
+        self.local_batch = int(local_batch)
+        self.data_size = mesh.shape[DATA_AXIS]
+        self.global_batch = self.local_batch * self.data_size
+        self._step_cache = {}
+
+    # -- program ------------------------------------------------------------
+
+    def _adj_sizes(self, caps) -> list[tuple[int, int]]:
+        """Static Adj sizes, deepest layer first (sampler output order)."""
+        sizes = []
+        prev = self.local_batch
+        for cap in caps:
+            sizes.append((cap, prev))
+            prev = cap
+        return sizes[::-1]
+
+    def _compiled_step(self, caps: tuple, feat_dim: int):
+        key_ = (caps, feat_dim)
+        if key_ in self._step_cache:
+            return self._step_cache[key_]
+
+        model, tx = self.model, self.tx
+        S = self.local_batch
+        adj_sizes = self._adj_sizes(caps)
+
+        def body(params, opt_state, x, eis, n_id, bsz, labels, key):
+            # blocks arrive with a leading length-1 shard dim; squeeze it
+            x_b = x[0]
+            adjs = [
+                Adj(ei[0], None, sz) for ei, sz in zip(eis, adj_sizes)
+            ]
+            seed_ids = n_id[0][:S]
+            lab = labels[jnp.clip(seed_ids, 0)]
+            # mask by the block's true batch size: for a short block, lanes
+            # [bsz, S) of n_id hold FRONTIER nodes (masked_unique compacts
+            # first-occurrence order), not -1 — they must not be trained on
+            mask = (jnp.arange(S) < bsz[0]) & (seed_ids >= 0)
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+
+            def loss_fn(p):
+                logits = model.apply(
+                    {"params": p}, x_b, adjs, train=True, rngs={"dropout": key}
+                )
+                return cross_entropy_on_seeds(logits[:S], lab, mask)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        n_layers = len(caps)
+        fn = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(
+                P(),
+                P(),
+                P(DATA_AXIS),
+                tuple([P(DATA_AXIS)] * n_layers),
+                P(DATA_AXIS),
+                P(DATA_AXIS),
+                P(),
+                P(),
+            ),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        step = jax.jit(fn)
+        self._step_cache[key_] = step
+        return step
+
+    # -- API ----------------------------------------------------------------
+
+    def init(self, rng):
+        """Initialize params/opt_state from one sampled block."""
+        n = self.sampler.csr_topo.node_count
+        m = min(self.local_batch, n)
+        out = self.sampler.sample(np.arange(m))
+        x = self.feature[out.n_id]
+        params = self.model.init({"params": rng}, x, out.adjs)["params"]
+        return params, self.tx.init(params)
+
+    def seed_blocks(self, seeds: np.ndarray):
+        """Split a global seed array into per-device blocks
+        (``train_idx.split(world_size)[rank]`` parity)."""
+        seeds = np.asarray(seeds)
+        blocks = np.array_split(seeds, self.data_size)
+        for b in blocks:
+            if len(b) > self.local_batch:
+                raise ValueError(
+                    f"block {len(b)} exceeds local_batch {self.local_batch}"
+                )
+        return blocks
+
+    def _stack(self, batches):
+        """Stack D per-worker (out, x) into data-sharded step inputs."""
+        caps = None
+        for b in batches:
+            c = tuple(a.size[0] for a in b.out.adjs[::-1])
+            if caps is None:
+                caps = c
+            elif c != caps:
+                raise ValueError(
+                    "sampled blocks disagree on frontier caps "
+                    f"({caps} vs {c}); pin frontier_caps on the sampler "
+                    "(auto caps may replan between blocks)"
+                )
+        n_layers = len(caps)
+        x = self._shard_stack([b.x for b in batches])
+        n_id = self._shard_stack([b.out.n_id for b in batches])
+        eis = tuple(
+            self._shard_stack([b.out.adjs[l].edge_index for b in batches])
+            for l in range(n_layers)
+        )
+        bsz = self._shard_stack(
+            [jnp.int32(b.out.batch_size) for b in batches]
+        )
+        return caps, x, n_id, eis, bsz
+
+    def _shard_stack(self, blocks):
+        """Stack D per-worker arrays directly onto their target devices.
+
+        Equivalent to ``device_put(jnp.stack(blocks), P(DATA_AXIS))`` but
+        never materializes the full stacked batch on one device — each
+        block hops straight to its shard's device (one transfer per block,
+        no device-0 peak)."""
+        devs = self.mesh.devices.reshape(self.data_size, -1)[:, 0]
+        shards = [
+            jax.device_put(jnp.asarray(b)[None], d)
+            for b, d in zip(blocks, devs)
+        ]
+        shape = (self.data_size,) + tuple(shards[0].shape[1:])
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, shards
+        )
+
+    def step(self, params, opt_state, batches, labels, key):
+        """One DP step from D prefetched batches (``Prefetcher`` Batch or
+        anything with ``.out``/``.x``). ``labels``: full (N,) array."""
+        if len(batches) != self.data_size:
+            raise ValueError(
+                f"need {self.data_size} batches (one per data shard), "
+                f"got {len(batches)}"
+            )
+        caps, x, n_id, eis, bsz = self._stack(batches)
+        step = self._compiled_step(caps, x.shape[-1])
+        return step(params, opt_state, x, eis, n_id, bsz, labels, key)
+
+    def train_epoch(self, params, opt_state, train_idx, labels, key,
+                    rng=None, depth: int = 2):
+        """One epoch with prefetch overlap: sample+gather for the next
+        step's blocks runs while the current step computes.
+
+        Returns (params, opt_state, mean_loss, num_steps).
+        """
+        rng = rng or np.random.default_rng(0)
+        train_idx = np.asarray(train_idx)
+        perm = rng.permutation(len(train_idx))
+        steps = max(len(train_idx) // self.global_batch, 1)
+        blocks = []
+        for s in range(steps):
+            chunk = train_idx[perm[s * self.global_batch:(s + 1) * self.global_batch]]
+            blocks.extend(self.seed_blocks(chunk))
+
+        losses = []
+        group = []
+        for batch in Prefetcher(self.sampler, self.feature, depth=depth).run(blocks):
+            group.append(batch)
+            if len(group) == self.data_size:
+                key, sub = jax.random.split(key)
+                params, opt_state, loss = self.step(
+                    params, opt_state, group, labels, sub
+                )
+                losses.append(loss)
+                group = []
+        mean_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+        return params, opt_state, mean_loss, len(losses)

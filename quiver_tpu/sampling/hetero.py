@@ -72,6 +72,9 @@ class HeteroSampleOutput(NamedTuple):
     batch_size: int
     adjs: list  # HeteroLayer records, deepest first
     overflow: jax.Array  # total uniques dropped by caps (0 = exact)
+    # per-hop UNCLIPPED unique counts {type: scalar}, seeds-outward order —
+    # what the auto-cap planner reads (homogeneous frontier_counts analogue)
+    frontier_counts: tuple = ()
 
 
 def _normalize_sizes(sizes, topo: HeteroCSRTopo):
@@ -120,6 +123,7 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
     frontier = {input_type: seeds}
     counts = {input_type: num_seeds}
     layers = []
+    frontier_counts = []
     overflow = jnp.zeros((), jnp.int32)
 
     for rel_fanouts, caps_prev, caps_next in layer_plans:
@@ -137,6 +141,7 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
         #    relation's samples targeting this src type, concatenated in a
         #    deterministic relation order
         new_frontier, new_counts, locals_per_rel = {}, {}, {}
+        layer_uniques = {}
         for t, cap in caps_next.items():
             blocks, valids, spans = [], [], {}
             prev = frontier.get(t)
@@ -161,6 +166,7 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
                                                num_forced=n_prev)
             new_frontier[t] = uniq
             new_counts[t] = jnp.minimum(num_u, cap)
+            layer_uniques[t] = num_u
             overflow = overflow + jnp.maximum(num_u - cap, 0)
             for et, (off, ln) in spans.items():
                 locals_per_rel[et] = local[off:off + ln]
@@ -181,10 +187,11 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
             edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
             adjs[et] = Adj(edge_index, None, (caps_next[s_t], S))
         layers.append(HeteroLayer(adjs, dict(caps_next), dict(caps_prev)))
+        frontier_counts.append(layer_uniques)
 
         frontier, counts = new_frontier, new_counts
 
-    return frontier, counts, layers[::-1], overflow
+    return frontier, counts, layers[::-1], overflow, tuple(frontier_counts)
 
 
 class HeteroGraphSampler:
@@ -198,12 +205,20 @@ class HeteroGraphSampler:
       input_type: node type of the seeds.
       mode: topology placement, "GPU"/HBM or "UVA"/host.
       seed_capacity: padded seed batch; defaults to first batch rounded up.
+      frontier_caps: ``"auto"`` right-sizes every per-hop/per-type capacity
+        from the first batch's observed unique counts (x ``auto_margin``) —
+        the homogeneous auto planner (sampler.py) ported to typed frontiers.
+        Worst-case caps overshoot ~3x on power-law graphs (SURVEY §7.4.2),
+        and R-GCN pays that in every gather/aggregate. Default: worst case.
       seed: PRNG seed.
+      auto_margin: headroom factor for "auto" caps (>= 1).
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
                  input_type: str, mode: str | SampleMode = SampleMode.HBM,
-                 seed_capacity: int | None = None, seed: int = 0):
+                 seed_capacity: int | None = None,
+                 frontier_caps: str | None = None, seed: int = 0,
+                 auto_margin: float = 1.25):
         if input_type not in topo.num_nodes:
             raise ValueError(f"unknown input_type {input_type!r}")
         self.topo = topo
@@ -212,17 +227,32 @@ class HeteroGraphSampler:
         self.mode = SampleMode.parse(mode)
         self.dev_topos = topo.to_device(self.mode)
         self._seed_capacity = seed_capacity
+        if frontier_caps not in (None, "auto"):
+            raise ValueError(
+                f"frontier_caps must be None or 'auto', got {frontier_caps!r}"
+            )
+        self._auto_caps = frontier_caps == "auto"
+        self._auto_margin = float(auto_margin)
+        if self._auto_margin < 1.0:
+            raise ValueError(f"auto_margin must be >= 1.0, got {auto_margin}")
+        # per-layer {type: cap} overrides planned from observed counts
+        self._cap_overrides: tuple | None = None
         self._key = jax.random.PRNGKey(seed)
         self._call = 0
         self._compiled_cache = {}
 
     # -- static planning ----------------------------------------------------
 
-    def _plan(self, seed_cap: int):
-        """Per-hop (active relations, caps before, caps after)."""
+    def _plan(self, seed_cap: int, overrides: tuple | None = None):
+        """Per-hop (active relations, caps before, caps after).
+
+        ``overrides`` (auto mode): per-layer {type: planned cap}; each is
+        clamped into [previous hop's cap, worst case] so the seeds-first
+        invariant and correctness bounds hold no matter what was observed.
+        """
         caps = {self.input_type: seed_cap}
         plans = []
-        for layer in self.sizes:
+        for li, layer in enumerate(self.sizes):
             active = {
                 et: k for et, k in layer.items()
                 if caps.get(et[2], 0) > 0 and k > 0
@@ -237,19 +267,46 @@ class HeteroGraphSampler:
                 # previous hop's capacity: forced (seeds-first) lanes keep
                 # duplicates as distinct slots, so the frontier must always
                 # be able to hold the full previous frontier
-                caps_next[t] = _round_up(
+                worst = _round_up(
                     max(min(caps_next[t], self.topo.num_nodes[t]),
                         caps.get(t, 0)),
                     8,
                 )
+                cap = worst
+                if overrides is not None and t in overrides[li]:
+                    cap = _round_up(int(overrides[li][t]), 128)
+                    cap = max(cap, caps.get(t, 0), 128)
+                    cap = min(cap, worst)
+                caps_next[t] = cap
             plans.append((active, dict(caps), caps_next))
             caps = caps_next
         return tuple(plans)
 
+    def _plan_auto(self, observed: Sequence[dict]) -> None:
+        """Fold a run's per-layer unclipped unique counts into the cap
+        overrides (margin headroom; never shrinking below a previous plan)."""
+        old = self._cap_overrides or tuple({} for _ in observed)
+        new = []
+        for obs, prev in zip(observed, old):
+            layer = dict(prev)
+            for t, n in obs.items():
+                want = int(self._auto_margin * int(n))
+                layer[t] = max(want, prev.get(t, 0))
+            new.append(layer)
+        self._cap_overrides = tuple(new)
+
     def _compiled(self, seed_cap: int):
-        if seed_cap in self._compiled_cache:
-            return self._compiled_cache[seed_cap]
-        plans = self._plan(seed_cap)
+        ov = self._cap_overrides
+        cache_key = (
+            seed_cap,
+            None if ov is None
+            else tuple(tuple(sorted(layer.items())) for layer in ov),
+        )
+        if cache_key in self._compiled_cache:
+            return self._compiled_cache[cache_key]
+        plans = self._plan(
+            seed_cap, self._cap_overrides if self._auto_caps else None
+        )
         input_type = self.input_type
 
         @jax.jit
@@ -258,7 +315,7 @@ class HeteroGraphSampler:
                 dev_topos, seeds, num_seeds, key, input_type, plans
             )
 
-        self._compiled_cache[seed_cap] = run
+        self._compiled_cache[cache_key] = run
         return run
 
     # -- public API ----------------------------------------------------------
@@ -280,7 +337,33 @@ class HeteroGraphSampler:
         run = self._compiled(cap)
         self._call += 1
         key = jax.random.fold_in(self._key, self._call)
-        frontier, counts, layers, overflow = run(
-            self.dev_topos, jnp.asarray(padded), jnp.int32(batch), key
+        dev_seeds = jnp.asarray(padded)
+        frontier, counts, layers, overflow, fcounts = run(
+            self.dev_topos, dev_seeds, jnp.int32(batch), key
         )
-        return HeteroSampleOutput(frontier, counts, batch, layers, overflow)
+        if self._auto_caps:
+            # same discipline as the homogeneous sampler: one scalar sync per
+            # call to watch for overflow; regrow is bounded and saturates at
+            # worst-case caps (then the clipped result + report stand)
+            first_plan = self._cap_overrides is None
+            for _ in range(len(self.sizes) + 2):
+                if not first_plan and int(overflow) == 0:
+                    break
+                observed = [
+                    {t: int(v) for t, v in layer.items()} for layer in fcounts
+                ]
+                before = self._cap_overrides
+                self._plan_auto(observed)
+                if not first_plan and self._cap_overrides == before:
+                    break  # saturated: rerunning the same program can't help
+                if first_plan and int(overflow) == 0:
+                    first_plan = False
+                    break  # worst-case first run was exact; keep its result
+                run = self._compiled(cap)
+                frontier, counts, layers, overflow, fcounts = run(
+                    self.dev_topos, dev_seeds, jnp.int32(batch), key
+                )
+                first_plan = False
+        return HeteroSampleOutput(
+            frontier, counts, batch, layers, overflow, fcounts
+        )
