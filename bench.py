@@ -1,14 +1,188 @@
-"""Headline benchmark: sampled edges per second (SEPS) on the real chip.
+"""Headline benchmark supervisor: sampled edges per second on the real chip.
 
-Thin wrapper over ``benchmarks.bench_sampler`` (single source of truth for
-the SEPS methodology — see benchmarks/README.md) with the headline config as
-defaults: products-scale synthetic power-law graph, fanout [15,10,5], batch
-2048, HBM-resident topology. Prints ONE JSON line:
-``{"metric", "value", "unit", "vs_baseline", ...}`` with vs_baseline against
-the reference's 34.29M 1-GPU UVA SEPS (docs/Introduction_en.md:41).
+Round-3 discipline (VERDICT r2 item 1): two rounds of benches died rc=1 with
+no JSON because a failure *after* backend init — the first jit compile — was
+unguarded. This supervisor never imports jax. It runs the measured body
+(``benchmarks.bench_sampler``, the single source of truth for the SEPS
+methodology — see benchmarks/README.md) in a watchdogged subprocess and
+guarantees exactly ONE parseable JSON line on stdout and rc=0:
+
+1. probe the backend in a throwaway subprocess under a short timeout (a hung
+   tunnel costs minutes, not the full attempt budget), then settle briefly
+   so the probe's chip hold is released before the child's own init (the
+   r02 failure — probe ok, first compile UNAVAILABLE seconds later — smells
+   like exactly that hold/release race);
+2. run the child on the default backend under a hard timeout;
+3. if the child *errored* (fast), retry once after a delay — transient
+   single-chip contention; if it *hung* (slow), don't burn a second full
+   budget on a dead tunnel;
+4. on exhaustion, re-run pinned to CPU in smoke mode (a labeled degraded
+   number beats no number);
+5. if even that fails, emit a diagnostic JSON line from this process.
+
+Headline config: products-scale synthetic power-law graph, fanout [15,10,5],
+batch 2048, HBM-resident topology. ``vs_baseline`` is against the
+reference's 34.29M 1-GPU UVA SEPS (docs/Introduction_en.md:41).
 """
 
-from benchmarks.bench_sampler import main
+import json
+import os
+import subprocess
+import sys
+import time
+
+CHILD = ["-m", "benchmarks.bench_sampler"]
+# one real-chip attempt budget: first jit compile alone is 20-40s; the
+# products-scale graph build is ~10s; 50 measured iters a few seconds.
+ATTEMPT_TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1500))
+PROBE_TIMEOUT = float(os.environ.get("QUIVER_BENCH_PROBE_TIMEOUT", 240))
+RETRY_DELAY = float(os.environ.get("QUIVER_BENCH_RETRY_DELAY", 30))
+SETTLE_S = float(os.environ.get("QUIVER_BENCH_SETTLE", 5))
+
+# the image's sitecustomize pins the TPU plugin before env vars are read,
+# so JAX_PLATFORMS=cpu must be re-applied via jax.config (same workaround as
+# tests/conftest.py and benchmarks.common.init_backend)
+_PROBE_SRC = (
+    "import os, jax;"
+    "p = [x.strip().lower() for x in"
+    " os.environ.get('JAX_PLATFORMS', '').split(',') if x.strip()];"
+    "p == ['cpu'] and jax.config.update('jax_platforms', 'cpu');"
+    "import jax.numpy as jnp;"
+    "jnp.zeros(8).block_until_ready();"
+    "print(jax.devices()[0].platform, flush=True)"
+)
+
+
+def _log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _env(overrides):
+    env = dict(os.environ)
+    env.update(overrides)
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else repo_root
+    )
+    return env
+
+
+def _probe(timeout_s):
+    """Backend reachable? (ok, detail) from a throwaway subprocess."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=_env({}),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung > {timeout_s:.0f}s (tunnel unresponsive)"
+    if r.returncode != 0:
+        return False, (r.stderr or r.stdout).strip()[-400:]
+    return True, f"{r.stdout.strip()} in {time.time() - t0:.1f}s"
+
+
+def _find_json(text: str):
+    """Last stdout line that parses as a result record (has "metric")."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
+def _attempt(extra_args, env_overrides, timeout_s, label):
+    """Run the measured child once. Returns (record|None, error, hung)."""
+    env = _env(env_overrides)
+    # the child is watchdogged HERE: it must skip its own subprocess probe
+    # (slow, and briefly holds the single chip right before the child's
+    # init) and fail fast instead of self-healing, so WE control fallback.
+    env["QUIVER_BENCH_SUPERVISED"] = "1"
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    argv = [sys.executable] + CHILD + extra_args + sys.argv[1:]
+    _log(f"{label}: {' '.join(argv[1:])}")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=repo_root,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = e.stderr or ""
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        sys.stderr.write(tail[-2000:])
+        _log(f"{label}: hung > {timeout_s:.0f}s (killed)")
+        return None, f"timeout>{timeout_s:.0f}s", True
+    sys.stderr.write(r.stderr[-4000:])
+    rec = _find_json(r.stdout)
+    dt = time.time() - t0
+    if rec is not None:
+        _log(f"{label}: ok in {dt:.0f}s")
+        return rec, None, False
+    err = (r.stderr or r.stdout).strip()[-600:] or f"rc={r.returncode}, no output"
+    _log(f"{label}: failed rc={r.returncode} in {dt:.0f}s")
+    return None, err, False
+
+
+def main():
+    errors = []
+    for n in (1, 2):
+        if n == 2:
+            _log(f"retrying in {RETRY_DELAY:.0f}s (transient chip contention?)")
+            time.sleep(RETRY_DELAY)
+        ok, detail = _probe(PROBE_TIMEOUT)
+        _log(f"attempt {n} probe: {'ok ' + detail if ok else detail}")
+        if not ok:
+            errors.append(f"probe: {detail}")
+            continue
+        time.sleep(SETTLE_S)  # let the probe's chip hold fully release
+        rec, err, hung = _attempt([], {}, ATTEMPT_TIMEOUT,
+                                  f"attempt {n} (default backend)")
+        if rec is not None:
+            print(json.dumps(rec))
+            return 0
+        errors.append(err)
+        if hung:
+            # a hang AFTER a successful probe: the tunnel died mid-run;
+            # don't burn a second full budget on it
+            _log("attempt hung after a good probe; skipping the retry")
+            break
+
+    rec, err, _ = _attempt(
+        ["--smoke"],
+        {"JAX_PLATFORMS": "cpu",
+         "QUIVER_BENCH_DEGRADED": f"supervisor fallback: {errors[-1][:200]}"
+         if errors else "supervisor fallback"},
+        min(ATTEMPT_TIMEOUT, 600),
+        "fallback (CPU smoke)",
+    )
+    if rec is not None:
+        print(json.dumps(rec))
+        return 0
+    errors.append(err)
+
+    # absolute last resort: the supervisor itself emits the labeled line so
+    # the round still records a parseable result.
+    print(json.dumps({
+        "metric": "sampled-edges/sec/chip",
+        "value": 0.0,
+        "unit": "SEPS",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "degraded": "all attempts failed",
+        "errors": [str(e)[:300] for e in errors],
+    }))
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -16,7 +16,14 @@ import time
 
 import numpy as np
 
-from benchmarks.common import PRODUCTS_TRAIN_NODES, base_parser, build_graph, emit, log
+from benchmarks.common import (
+    PRODUCTS_TRAIN_NODES,
+    base_parser,
+    build_graph,
+    emit,
+    log,
+    run_guarded,
+)
 
 BASELINE_EPOCH_S = 11.1
 
@@ -39,7 +46,10 @@ def main():
     p.add_argument("--train-nodes", type=int, default=PRODUCTS_TRAIN_NODES)
     p.set_defaults(batch=1024, iters=40, warmup=3)
     args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
 
+
+def _body(args):
     import jax
     import jax.numpy as jnp
     import optax

@@ -19,7 +19,7 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, build_graph, emit, log
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
 
 BASELINE_GBPS = 14.82
 
@@ -38,7 +38,10 @@ def main():
     )
     p.set_defaults(iters=50, warmup=5)
     args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
 
+
+def _body(args):
     import jax
     import jax.numpy as jnp
 

@@ -14,7 +14,7 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, emit, init_backend, log
+from benchmarks.common import base_parser, emit, init_backend, log, run_guarded
 
 
 def main():
@@ -26,7 +26,10 @@ def main():
     p.add_argument("--caps", default="auto", choices=["auto", "worst"])
     p.set_defaults(nodes=200_000, batch=512, iters=30, warmup=3)
     args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
 
+
+def _body(args):
     init_backend(
         retries=getattr(args, "backend_retries", 1),
         delay=getattr(args, "backend_retry_delay", 15.0),

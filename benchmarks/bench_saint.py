@@ -1,0 +1,77 @@
+"""GraphSAINT subgraph-sampling throughput benchmark.
+
+No reference baseline exists (torch-quiver's ``qv.saint_subgraph`` never
+landed — rotted stubs, SURVEY §2.5); this tracks the framework's own SAINT
+capability after the round-3 devicification (VERDICT r2 item 5): each
+``sample()`` is ONE compiled program (draw → masked_unique dedup → induced
+subgraph), so the measured rate is pure device throughput with a single
+host sync per draw.
+
+Metrics: subgraphs/sec and induced edges/sec for the chosen sampler.
+"""
+
+import time
+
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--sampler", default="node", choices=["node", "edge", "rw"])
+    p.add_argument("--budget", type=int, default=4096,
+                   help="node budget (node), edge budget (edge)")
+    p.add_argument("--roots", type=int, default=1024)
+    p.add_argument("--walk-length", type=int, default=3)
+    p.set_defaults(nodes=500_000, iters=50, warmup=5)
+    args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
+
+
+def _body(args):
+    import jax
+
+    from quiver_tpu.sampling.saint import (
+        SAINTEdgeSampler,
+        SAINTNodeSampler,
+        SAINTRandomWalkSampler,
+    )
+
+    topo = build_graph(args)
+    if args.sampler == "node":
+        s = SAINTNodeSampler(topo, budget=args.budget, seed=args.seed)
+    elif args.sampler == "edge":
+        s = SAINTEdgeSampler(topo, budget=args.budget, seed=args.seed)
+    else:
+        s = SAINTRandomWalkSampler(
+            topo, roots=args.roots, walk_length=args.walk_length,
+            seed=args.seed,
+        )
+
+    t0 = time.time()
+    for _ in range(max(args.warmup, 1)):  # >= 1: the first call compiles
+        sub = s.sample()
+    jax.block_until_ready(sub.node_id)
+    log(f"warmup+compile: {time.time() - t0:.1f}s; deg_cap={s.deg_cap}")
+
+    total_edges = 0
+    t0 = time.time()
+    for _ in range(args.iters):
+        sub = s.sample()
+        total_edges += int(sub.num_edges)  # one scalar sync per draw
+    jax.block_until_ready(sub.node_id)
+    dt = time.time() - t0
+
+    emit(
+        "saint-subgraphs/sec",
+        args.iters / dt,
+        "subgraphs/s",
+        None,
+        sampler=args.sampler,
+        induced_edges_per_sec=round(total_edges / dt, 1),
+        budget=s.budget,
+        deg_cap=s.deg_cap,
+    )
+
+
+if __name__ == "__main__":
+    main()

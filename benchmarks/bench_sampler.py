@@ -16,7 +16,7 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, build_graph, emit, log
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
 
 BASELINE_UVA_SEPS = 34.29e6
 
@@ -34,7 +34,10 @@ def main():
     )
     p.set_defaults(warmup=25, iters=50)
     args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
 
+
+def _body(args):
     import jax
 
     from quiver_tpu import GraphSageSampler

@@ -148,6 +148,21 @@ def _reexec_cpu_smoke(reason: str):
     os.execve(sys.executable, argv, env)
 
 
+def _supervised() -> bool:
+    """True when the repo-root ``bench.py`` supervisor is watchdogging us.
+
+    Under the supervisor the division of labor changes: IT owns hang
+    timeouts, retries, and the CPU fallback, so this process must (a) not
+    burn budget on the throwaway subprocess probe — which also briefly holds
+    the single chip right before our own init, the r02 contention suspect —
+    and (b) fail FAST on errors instead of self-healing, so the supervisor
+    can retry on the real backend before degrading.
+    """
+    import os
+
+    return bool(os.environ.get("QUIVER_BENCH_SUPERVISED"))
+
+
 def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 180.0):
     """Touch the JAX backend FIRST and fail fast with a diagnostic.
 
@@ -184,6 +199,13 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
         # CPU backend cannot hang; skip the subprocess probe
         dev = jax.devices()[0]
         log(f"backend ok: {dev.platform} (forced cpu)")
+        return dev
+
+    if _supervised():
+        # no probe, no watchdog thread: the supervisor kills us on hang and
+        # retries on error. Just touch the backend directly.
+        dev = jax.devices()[0]
+        log(f"backend ok: {dev.platform} (supervised)")
         return dev
 
     last_err = None
@@ -238,6 +260,60 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
 
 # set when init_backend fell back to CPU; emit() stamps it into the JSON
 _DEGRADED_REASON: str | None = None
+
+
+def run_guarded(body, args):
+    """Run the measured body (setup + first compile + measure) under the same
+    failure discipline ``init_backend`` has.
+
+    Round-2 lesson (VERDICT r2): the harness guarded backend *init* and then
+    died, unguarded, at the first jit *compile*
+    (``JaxRuntimeError: UNAVAILABLE``) — no JSON, rc=1. Every benchmark's
+    post-argparse work goes through here:
+
+    * on exception, retry once after a delay (the observed failure pattern —
+      probe ok, first compile UNAVAILABLE — is transient single-chip
+      contention; a fresh attempt recompiles from scratch);
+    * supervised (repo-root ``bench.py``): exhausted retries exit nonzero
+      fast so the supervisor can retry on the real backend before degrading;
+    * standalone strict (``QUIVER_BENCH_STRICT``): emit an error-labeled JSON
+      line and exit 2;
+    * standalone default: re-exec as a CPU smoke run — a labeled degraded
+      number beats no number.
+    """
+    import os
+
+    retries = getattr(args, "backend_retries", 1)
+    delay = getattr(args, "backend_retry_delay", 15.0)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            return body()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — any failure must yield JSON
+            last = f"{type(e).__name__}: {str(e)[:400]}"
+            log(f"measured body failed (attempt {attempt + 1}/{retries + 1}): {last}")
+            if attempt < retries:
+                log(f"retrying in {delay:.0f}s...")
+                time.sleep(delay)
+
+    if _supervised():
+        log("FATAL: measured body failed after retries (supervised; "
+            "supervisor owns the fallback).")
+        sys.exit(3)
+    if os.environ.get("QUIVER_BENCH_STRICT"):
+        print(json.dumps({
+            "metric": "measured-body",
+            "value": None,
+            "unit": "error",
+            "vs_baseline": None,
+            "error": last,
+        }))
+        sys.exit(2)
+    log("WARNING: measured body unrunnable on this backend; re-exec as CPU "
+        f"smoke. (reason: {last})")
+    _reexec_cpu_smoke(last)  # never returns
 
 
 def apply_smoke(args) -> None:

@@ -20,7 +20,14 @@ import time
 
 import numpy as np
 
-from benchmarks.common import apply_smoke, base_parser, emit, init_backend, log
+from benchmarks.common import (
+    apply_smoke,
+    base_parser,
+    emit,
+    init_backend,
+    log,
+    run_guarded,
+)
 
 
 def validate_sampler_correctness(topo, dev, fanout, batch, seed):
@@ -107,7 +114,10 @@ def main():
     p.add_argument("--trials", type=int, default=50)
     p.set_defaults(nodes=500_000, iters=30)
     args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
 
+
+def _body(args):
     dev0 = init_backend(retries=getattr(args, "backend_retries", 1))
     apply_smoke(args)
     on_tpu = dev0.platform == "tpu"

@@ -18,11 +18,14 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax.numpy as jnp
-
 from .config import SampleMode
-from .memory import to_pinned_host
-from .topology import DeviceTopology, _as_numpy, _build_csr
+from .topology import (
+    DeviceTopology,
+    _as_numpy,
+    _build_csr,
+    _row_prefix_weights,
+    place_csr_arrays,
+)
 
 __all__ = ["RelCSR", "HeteroCSRTopo"]
 
@@ -41,6 +44,8 @@ class RelCSR:
         self._indptr = indptr.astype(np.int64, copy=False)
         self._indices = indices
         self._eid = eid
+        self._edge_weight = None
+        self._cum_weights = None
         self.src_node_count = int(src_node_count)
         if indices.size and int(indices.max()) >= src_node_count:
             raise ValueError(
@@ -96,15 +101,54 @@ class RelCSR:
     def max_degree(self) -> int:
         return int(self.degree.max(initial=0))
 
-    def to_device(self, mode: SampleMode | str = SampleMode.HBM) -> DeviceTopology:
-        mode = SampleMode.parse(mode)
-        indptr = jnp.asarray(self._indptr)
-        host = False
-        if mode == SampleMode.HOST:
-            indices, host = to_pinned_host(self._indices)
-        else:
-            indices = jnp.asarray(self._indices)
-        return DeviceTopology(indptr=indptr, indices=indices, host_indices=host)
+    @property
+    def eid(self) -> np.ndarray | None:
+        """CSR slot -> original COO edge position (None for direct builds)."""
+        return self._eid
+
+    # -- edge weights (weighted per-relation sampling) ----------------------
+
+    def set_edge_weight(self, edge_weight, coo_order: bool = True) -> "RelCSR":
+        """Attach per-edge weights (same contract as CSRTopo.set_edge_weight:
+        ``coo_order=True`` aligns with the COO build order via ``eid``)."""
+        w = _as_numpy(edge_weight).astype(np.float64, copy=False).reshape(-1)
+        if w.shape[0] != self.edge_count:
+            raise ValueError(
+                f"edge_weight must have {self.edge_count} entries, got {w.shape[0]}"
+            )
+        if w.size and not (np.isfinite(w).all() and w.min() >= 0):
+            raise ValueError("edge weights must be finite and non-negative")
+        if coo_order and self._eid is not None:
+            w = w[self._eid]
+        self._edge_weight = w.astype(np.float32)
+        self._cum_weights = _row_prefix_weights(w, self._indptr)
+        return self
+
+    @property
+    def edge_weight(self) -> np.ndarray | None:
+        return self._edge_weight
+
+    @property
+    def cum_weights(self) -> np.ndarray | None:
+        return self._cum_weights
+
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM,
+                  with_eid: bool = False,
+                  with_weights: bool = False) -> DeviceTopology:
+        """Place the relation for sampling — shares CSRTopo's placement
+        logic (place_csr_arrays): HOST mode keeps the large per-edge arrays
+        (indices/eid/cum_weights) in pinned host memory."""
+        if with_weights and self._cum_weights is None:
+            raise ValueError(
+                "weighted sampling requires edge weights; call "
+                "set_edge_weight() on this relation first"
+            )
+        return place_csr_arrays(
+            self._indptr, self._indices,
+            self._eid if with_eid else None,
+            self._cum_weights if with_weights else None,
+            self.max_degree, mode,
+        )
 
 
 class HeteroCSRTopo:
@@ -119,7 +163,7 @@ class HeteroCSRTopo:
     """
 
     def __init__(self, num_nodes: dict, edge_index_dict: dict,
-                 use_native: bool = True):
+                 use_native: bool = True, edge_weight_dict: dict | None = None):
         self.num_nodes = {str(t): int(n) for t, n in num_nodes.items()}
         self.relations: dict[EdgeType, RelCSR] = {}
         for etype, ei in edge_index_dict.items():
@@ -133,6 +177,22 @@ class HeteroCSRTopo:
             self.relations[(s, r, d)] = RelCSR.from_edge_index(
                 ei, self.num_nodes[d], self.num_nodes[s], use_native
             )
+        for etype, w in (edge_weight_dict or {}).items():
+            self.set_edge_weight(etype, w)
+
+    def set_edge_weight(self, edge_type, edge_weight,
+                        coo_order: bool = True) -> "HeteroCSRTopo":
+        """Attach per-edge weights to one relation (COO order by default)."""
+        et = tuple(str(t) for t in edge_type)
+        if et not in self.relations:
+            raise ValueError(f"unknown relation {edge_type!r}")
+        self.relations[et].set_edge_weight(edge_weight, coo_order)
+        return self
+
+    @property
+    def weighted_edge_types(self) -> list:
+        return [et for et, rel in self.relations.items()
+                if rel.cum_weights is not None]
 
     @property
     def node_types(self) -> list:
@@ -152,5 +212,14 @@ class HeteroCSRTopo:
             f"relations={[f'{s}-{r}->{d}' for s, r, d in self.relations]})"
         )
 
-    def to_device(self, mode: SampleMode | str = SampleMode.HBM) -> dict:
-        return {et: rel.to_device(mode) for et, rel in self.relations.items()}
+    def to_device(self, mode: SampleMode | str = SampleMode.HBM,
+                  with_eid: bool = False, weighted_rels=()) -> dict:
+        weighted_rels = {tuple(et) for et in weighted_rels}
+        unknown = weighted_rels - set(self.relations)
+        if unknown:
+            raise ValueError(f"unknown weighted relations: {unknown}")
+        return {
+            et: rel.to_device(mode, with_eid=with_eid,
+                              with_weights=et in weighted_rels)
+            for et, rel in self.relations.items()
+        }

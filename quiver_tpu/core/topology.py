@@ -239,35 +239,55 @@ class CSRTopo:
         path). ``with_weights`` ships the prefix-weight array for weighted
         sampling (requires ``set_edge_weight`` first).
         """
-        mode = SampleMode.parse(mode)
-        indptr = jnp.asarray(self._indptr)
-        eid = jnp.asarray(self._eid) if (with_eid and self._eid is not None) else None
-        cum_w = None
-        if with_weights:
-            if self._cum_weights is None:
-                raise ValueError(
-                    "weighted sampling requires edge weights; call "
-                    "set_edge_weight() or pass edge_weight= to CSRTopo"
-                )
-            cum_w = self._cum_weights
-        host = False
-        if mode == SampleMode.HOST:
-            indices, host = to_pinned_host(self._indices)
-            if eid is not None and host:
-                eid, _ = to_pinned_host(self._eid)
-            if cum_w is not None and host:
-                cum_w, _ = to_pinned_host(cum_w)
-            elif cum_w is not None:
-                cum_w = jnp.asarray(cum_w)
-        else:
-            indices = jnp.asarray(self._indices)
-            if cum_w is not None:
-                cum_w = jnp.asarray(cum_w)
-        # static iteration bound for the device-side per-row binary search
-        iters = max(int(np.ceil(np.log2(self.max_degree + 1))), 1) if cum_w is not None else 0
-        return DeviceTopology(indptr=indptr, indices=indices, eid=eid,
-                              cum_weights=cum_w, host_indices=host,
-                              search_iters=iters)
+        if with_weights and self._cum_weights is None:
+            raise ValueError(
+                "weighted sampling requires edge weights; call "
+                "set_edge_weight() or pass edge_weight= to CSRTopo"
+            )
+        return place_csr_arrays(
+            self._indptr, self._indices,
+            self._eid if with_eid else None,
+            self._cum_weights if with_weights else None,
+            self.max_degree, mode,
+        )
+
+
+def place_csr_arrays(indptr, indices, eid, cum_weights, max_degree: int,
+                     mode: SampleMode | str) -> "DeviceTopology":
+    """Shared CSR placement for CSRTopo and hetero RelCSR.
+
+    HBM mode puts everything in device memory; HOST mode keeps the large
+    per-edge arrays (indices/eid/cum_weights) in pinned host memory where
+    supported. Pass ``eid``/``cum_weights`` as None to omit them; the
+    weighted binary search's static iteration bound derives from
+    ``max_degree``.
+    """
+    mode = SampleMode.parse(mode)
+    indptr = jnp.asarray(indptr)
+    host = False
+    if mode == SampleMode.HOST:
+        indices, host = to_pinned_host(indices)
+        if eid is not None:
+            eid = to_pinned_host(eid)[0] if host else jnp.asarray(eid)
+        if cum_weights is not None:
+            cum_weights = (
+                to_pinned_host(cum_weights)[0] if host
+                else jnp.asarray(cum_weights)
+            )
+    else:
+        indices = jnp.asarray(indices)
+        if eid is not None:
+            eid = jnp.asarray(eid)
+        if cum_weights is not None:
+            cum_weights = jnp.asarray(cum_weights)
+    iters = (
+        max(int(np.ceil(np.log2(max_degree + 1))), 1)
+        if cum_weights is not None
+        else 0
+    )
+    return DeviceTopology(indptr=indptr, indices=indices, eid=eid,
+                          cum_weights=cum_weights, host_indices=host,
+                          search_iters=iters)
 
 
 @jax.tree_util.register_pytree_node_class

@@ -56,15 +56,54 @@ def resolve_gather_kernel(kernel: str) -> str:
     ``"auto"`` picks the Pallas row-DMA kernel (ops/pallas/gather.py — the
     ``quiver_tensor_gather`` analogue, shard_tensor.cu.hpp:16-58) on TPU,
     stock XLA take elsewhere (the Pallas interpreter on CPU is correct but
-    slow; XLA's CPU gather is fine).
+    slow; XLA's CPU gather is fine). On TPU, auto additionally proves the
+    kernel compiles and gathers correctly once per process before electing
+    it — a Pallas regression degrades auto to xla with a warning instead of
+    taking down every feature gather. An explicit ``kernel="pallas"``
+    bypasses the check (fail loudly on request).
     """
     validate_gather_kernel(kernel)
     if kernel == "auto":
         try:
-            return "pallas" if jax.default_backend() == "tpu" else "xla"
+            backend = jax.default_backend()
         except RuntimeError:
             return "xla"
+        if backend != "tpu":
+            return "xla"
+        return "pallas" if _pallas_gather_usable() else "xla"
     return kernel
+
+
+_PALLAS_GATHER_OK: bool | None = None
+
+
+def _pallas_gather_usable() -> bool:
+    """One-time compiled smoke of the Pallas gather (fail-safe for auto)."""
+    global _PALLAS_GATHER_OK
+    if _PALLAS_GATHER_OK is None:
+        try:
+            from ..ops.pallas.gather import gather_rows
+
+            table = jnp.arange(32 * 128, dtype=jnp.float32).reshape(32, 128)
+            ids = jnp.asarray([3, 0, 31, 7], dtype=jnp.int32)
+            out = np.asarray(jax.block_until_ready(gather_rows(table, ids)))
+            _PALLAS_GATHER_OK = bool(
+                np.array_equal(out, np.asarray(table)[np.asarray(ids)])
+            )
+            if not _PALLAS_GATHER_OK:
+                get_logger("feature").warning(
+                    "pallas gather smoke returned wrong rows; kernel=auto "
+                    "degrades to xla"
+                )
+        except Exception as e:  # noqa: BLE001 — any compile failure degrades
+            get_logger("feature").warning(
+                "pallas gather smoke failed (%s: %s); kernel=auto degrades "
+                "to xla",
+                type(e).__name__,
+                str(e)[:200],
+            )
+            _PALLAS_GATHER_OK = False
+    return _PALLAS_GATHER_OK
 
 
 def _hot_gather_fn(table, kernel: str):

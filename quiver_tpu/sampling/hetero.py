@@ -112,12 +112,18 @@ def _normalize_sizes(sizes, topo: HeteroCSRTopo):
 
 
 def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
-                             layer_plans):
+                             layer_plans, weighted_rels=frozenset(),
+                             with_eid: bool = False):
     """The jit-composable hetero sampling loop.
 
     ``layer_plans`` is a static tuple of per-hop plans, each
     ``(rel_fanouts, caps_prev, caps_next)`` where rel_fanouts maps active
     edge types to fanouts and caps_* map node types to static capacities.
+    ``weighted_rels`` (static) names edge types whose draws are
+    weight-proportional (their DeviceTopology must carry cum_weights);
+    ``with_eid`` threads per-edge global edge ids into every Adj — the
+    homogeneous contract (multilayer_sample, sampler.py) extended to typed
+    relations: ids are COO positions within each relation's own edge list.
     Returns (frontier dict, counts dict, layers deepest-first, overflow).
     """
     frontier = {input_type: seeds}
@@ -129,13 +135,17 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
     for rel_fanouts, caps_prev, caps_next in layer_plans:
         # 1) sample every active relation
         samples = {}  # edge_type -> (S, K) src-type global ids
+        eids = {}  # edge_type -> (S, K) relation-local edge ids
         for et, k in rel_fanouts.items():
             _, _, d = et
             key, sub = jax.random.split(key)
-            nbr, _ = sample_layer(
-                dev_topos[et], frontier[d], counts[d], k, sub
+            res = sample_layer(
+                dev_topos[et], frontier[d], counts[d], k, sub,
+                weighted=et in weighted_rels, with_eid=with_eid,
             )
-            samples[et] = nbr
+            samples[et] = res[0]
+            if with_eid:
+                eids[et] = res[2]
 
         # 2) per-type dedup: previous frontier first (forced), then each
         #    relation's samples targeting this src type, concatenated in a
@@ -185,7 +195,13 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
             )
             row = jnp.where(col >= 0, row, -1)
             edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
-            adjs[et] = Adj(edge_index, None, (caps_next[s_t], S))
+            e_id = None
+            if with_eid:
+                # re-mask with col: neighbors dropped by frontier-cap
+                # overflow must not leak their edge ids (same rule as the
+                # homogeneous loop)
+                e_id = jnp.where(col >= 0, eids[et], -1).reshape(-1)
+            adjs[et] = Adj(edge_index, e_id, (caps_next[s_t], S))
         layers.append(HeteroLayer(adjs, dict(caps_next), dict(caps_prev)))
         frontier_counts.append(layer_uniques)
 
@@ -212,20 +228,53 @@ class HeteroGraphSampler:
         and R-GCN pays that in every gather/aggregate. Default: worst case.
       seed: PRNG seed.
       auto_margin: headroom factor for "auto" caps (>= 1).
+      weighted: weight-proportional neighbor draws — ``True`` uses every
+        relation that has weights attached (``set_edge_weight``; at least one
+        required), or pass an iterable of edge types to weight exactly those
+        (each must have weights). Unlisted relations sample uniformly.
+      with_eid: populate every ``Adj.e_id`` with relation-local global edge
+        ids (COO positions) — the homogeneous sampler's contract
+        (sage_sampler.py:100-109 parity) extended to typed graphs.
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
                  input_type: str, mode: str | SampleMode = SampleMode.HBM,
                  seed_capacity: int | None = None,
                  frontier_caps: str | None = None, seed: int = 0,
-                 auto_margin: float = 1.25):
+                 auto_margin: float = 1.25, weighted=False,
+                 with_eid: bool = False):
         if input_type not in topo.num_nodes:
             raise ValueError(f"unknown input_type {input_type!r}")
         self.topo = topo
         self.input_type = input_type
         self.sizes = _normalize_sizes(sizes, topo)
         self.mode = SampleMode.parse(mode)
-        self.dev_topos = topo.to_device(self.mode)
+        self.with_eid = bool(with_eid)
+        if weighted is True:
+            weighted_rels = topo.weighted_edge_types
+            if not weighted_rels:
+                raise ValueError(
+                    "weighted=True requires at least one relation with edge "
+                    "weights; call topo.set_edge_weight() first"
+                )
+        elif weighted:
+            # str-normalize components like HeteroCSRTopo does its keys
+            weighted_rels = [tuple(str(t) for t in et) for et in weighted]
+            missing = [
+                et for et in weighted_rels
+                if et not in topo.relations
+                or topo.relations[et].cum_weights is None
+            ]
+            if missing:
+                raise ValueError(
+                    f"weighted relations need edge weights attached: {missing}"
+                )
+        else:
+            weighted_rels = []
+        self.weighted_rels = frozenset(weighted_rels)
+        self.dev_topos = topo.to_device(
+            self.mode, with_eid=self.with_eid, weighted_rels=self.weighted_rels
+        )
         self._seed_capacity = seed_capacity
         if frontier_caps not in (None, "auto"):
             raise ValueError(
@@ -308,11 +357,14 @@ class HeteroGraphSampler:
             seed_cap, self._cap_overrides if self._auto_caps else None
         )
         input_type = self.input_type
+        weighted_rels = self.weighted_rels
+        with_eid = self.with_eid
 
         @jax.jit
         def run(dev_topos, seeds, num_seeds, key):
             return hetero_multilayer_sample(
-                dev_topos, seeds, num_seeds, key, input_type, plans
+                dev_topos, seeds, num_seeds, key, input_type, plans,
+                weighted_rels=weighted_rels, with_eid=with_eid,
             )
 
         self._compiled_cache[cache_key] = run

@@ -15,6 +15,7 @@ the node set — no hash tables, no atomics (SURVEY §7.1).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import numpy as np
@@ -23,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.topology import CSRTopo
+from ..ops.reindex import masked_unique
 from ..ops.sample import sample_layer, staged_gather
 
 __all__ = [
@@ -114,8 +116,92 @@ def saint_subgraph(topo, nodes, num_nodes, deg_cap: int):
     )
 
 
+def _uniform_edge_positions(key, budget: int, edge_count: int, dtype):
+    """(budget,) uniform draws in [0, edge_count). ``edge_count`` is static
+    (an array shape), so the wide-graph branch resolves at trace time."""
+    if edge_count < 2**31:
+        return jax.random.randint(
+            key, (budget,), 0, edge_count, dtype=jnp.int32
+        ).astype(dtype)
+    # >2^31 edges: compose two 16-bit draws into a 32-bit mantissa-safe
+    # uniform and scale (float32 alone loses low bits past 2^24)
+    hi = jax.random.randint(key, (budget,), 0, 1 << 16, dtype=jnp.int32)
+    lo = jax.random.randint(
+        jax.random.fold_in(key, 1), (budget,), 0, 1 << 16, dtype=jnp.int32
+    )
+    u = (hi.astype(jnp.float64) * (1 << 16) + lo) / float(1 << 32)
+    return jnp.minimum((u * edge_count).astype(dtype), edge_count - 1)
+
+
+def _degree_proportional_nodes(topo, key, budget: int):
+    """Device-side degree-proportional node draw + first-occurrence dedup.
+
+    P(node) ∝ degree is exactly a uniform edge draw mapped to its source row:
+    ``indptr`` IS the degree CDF, so one ``searchsorted`` replaces the host
+    ``rng.choice(p=deg/deg.sum())`` (VERDICT r2 item 5 — no host RNG, no
+    per-batch ``np.unique``). A zero-edge graph degrades to uniform node
+    draws (the degree law is undefined), matching the host path's p=None
+    fallback; E is a static shape, so the branch resolves at trace time.
+    """
+    E = topo.indices.shape[0]
+    if E == 0:
+        n = topo.indptr.shape[0] - 1
+        src = jax.random.randint(key, (budget,), 0, max(n, 1), dtype=jnp.int32)
+    else:
+        r = _uniform_edge_positions(key, budget, E, topo.indptr.dtype)
+        src = (
+            jnp.searchsorted(topo.indptr, r, side="right").astype(jnp.int32) - 1
+        )
+    nodes, num, _ = masked_unique(src, jnp.ones(budget, bool), budget)
+    return nodes, jnp.minimum(num, budget)
+
+
+def _uniform_edge_endpoints(topo, key, budget: int):
+    """Device-side uniform edge draw -> dedup'd endpoint set (cap 2*budget)."""
+    E = topo.indices.shape[0]
+    eids = _uniform_edge_positions(key, budget, E, topo.indptr.dtype)
+    dst = staged_gather(topo.indices, eids, topo.host_indices).astype(jnp.int32)
+    src = (
+        jnp.searchsorted(topo.indptr, eids, side="right").astype(jnp.int32) - 1
+    )
+    both = jnp.concatenate([src, dst])
+    nodes, num, _ = masked_unique(both, both >= 0, 2 * budget)
+    return nodes, jnp.minimum(num, 2 * budget)
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "deg_cap"))
+def _saint_node_sample(topo, key, budget: int, deg_cap: int):
+    nodes, num = _degree_proportional_nodes(topo, key, budget)
+    return saint_subgraph(topo, nodes, num, deg_cap)
+
+
+@functools.partial(jax.jit, static_argnames=("budget", "deg_cap"))
+def _saint_edge_sample(topo, key, budget: int, deg_cap: int):
+    nodes, num = _uniform_edge_endpoints(topo, key, budget)
+    return saint_subgraph(topo, nodes, num, deg_cap)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("roots", "walk_length", "deg_cap")
+)
+def _saint_rw_sample(topo, key, roots: int, walk_length: int, deg_cap: int):
+    kr, kw = jax.random.split(key)
+    n_nodes = topo.indptr.shape[0] - 1
+    starts = jax.random.randint(kr, (roots,), 0, n_nodes, dtype=jnp.int32)
+    visited = random_walk(topo, starts, walk_length, kw).reshape(-1)
+    budget = roots * (walk_length + 1)
+    nodes, num, _ = masked_unique(visited, visited >= 0, budget)
+    return saint_subgraph(topo, nodes, jnp.minimum(num, budget), deg_cap)
+
+
 class _SaintSamplerBase:
-    """Shared machinery: node-budget padding, jitted subgraph extraction.
+    """Shared machinery: node-budget padding, fully-fused jitted sampling.
+
+    Each ``sample()`` is ONE compiled program — random draw, dedup
+    (ops/reindex.masked_unique), and subgraph induction all on device; the
+    host only advances the PRNG key (VERDICT r2 item 5: the original
+    round-1 design re-entered the host for ``np.unique`` + RNG every batch,
+    fine as preprocessing but a per-batch sync in a training loop).
 
     ``deg_cap`` defaults to the 99th-percentile degree (not max_degree: the
     subgraph extraction materializes (budget, deg_cap) blocks, and a
@@ -141,62 +227,40 @@ class _SaintSamplerBase:
         self._call += 1
         return jax.random.fold_in(self._key, self._call)
 
-    def _extract(self, nodes, num_nodes):
-        return _saint_subgraph_jit(self.topo, nodes, num_nodes, self.deg_cap)
-
     def sample(self) -> SaintSubgraph:
         raise NotImplementedError
-
-
-_saint_subgraph_jit = jax.jit(saint_subgraph, static_argnums=3)
 
 
 class SAINTNodeSampler(_SaintSamplerBase):
     """GraphSAINT-Node: sample ``budget`` nodes with probability proportional
     to degree (the paper's importance distribution), induce the subgraph."""
 
-    def __init__(self, csr_topo, budget, deg_cap=None, seed=0):
-        super().__init__(csr_topo, budget, deg_cap, seed)
-        deg = csr_topo.degree.astype(np.float64)
-        tot = deg.sum()
-        self._p = (deg / tot) if tot > 0 else None
-
     def sample(self) -> SaintSubgraph:
-        rng = np.random.default_rng(int(jax.random.randint(
-            self._next_key(), (), 0, np.iinfo(np.int32).max)))
-        picked = rng.choice(
-            self.csr_topo.node_count, size=self.budget, replace=True, p=self._p
+        return _saint_node_sample(
+            self.topo, self._next_key(), self.budget, self.deg_cap
         )
-        nodes = np.unique(picked).astype(np.int32)
-        padded = np.full(self.budget, -1, dtype=np.int32)
-        padded[: len(nodes)] = nodes
-        return self._extract(jnp.asarray(padded), jnp.int32(len(nodes)))
 
 
 class SAINTEdgeSampler(_SaintSamplerBase):
     """GraphSAINT-Edge: sample ``budget`` edges uniformly, take both
     endpoints as the node set, induce the subgraph. Node budget = 2*edges."""
 
+    def __init__(self, csr_topo, budget, deg_cap=None, seed=0):
+        if csr_topo.edge_count == 0:
+            raise ValueError("SAINTEdgeSampler needs a graph with edges")
+        super().__init__(csr_topo, budget, deg_cap, seed)
+
     def sample(self) -> SaintSubgraph:
-        rng = np.random.default_rng(int(jax.random.randint(
-            self._next_key(), (), 0, np.iinfo(np.int32).max)))
-        eids = rng.integers(0, self.csr_topo.edge_count, self.budget)
-        dst = self.csr_topo.indices[eids]
-        src = np.searchsorted(self.csr_topo.indptr, eids, side="right") - 1
-        nodes = np.unique(np.concatenate([src, dst])).astype(np.int32)
-        cap = 2 * self.budget
-        padded = np.full(cap, -1, dtype=np.int32)
-        padded[: len(nodes)] = nodes
-        return self._extract(jnp.asarray(padded), jnp.int32(len(nodes)))
+        return _saint_edge_sample(
+            self.topo, self._next_key(), self.budget, self.deg_cap
+        )
 
 
 class SAINTRandomWalkSampler(_SaintSamplerBase):
     """GraphSAINT-RW: ``roots`` uniform random roots, each walking
     ``walk_length`` uniform steps; the visited set induces the subgraph.
 
-    The walk itself runs on device (one fanout-1 sample per step, reusing
-    the layer sampler), so only the root draw happens host-side.
-    """
+    Roots, walk, dedup, and induction are a single compiled program."""
 
     def __init__(self, csr_topo, roots: int, walk_length: int,
                  deg_cap=None, seed=0):
@@ -206,17 +270,10 @@ class SAINTRandomWalkSampler(_SaintSamplerBase):
         self.walk_length = int(walk_length)
 
     def sample(self) -> SaintSubgraph:
-        key = self._next_key()
-        kr, kw = jax.random.split(key)
-        starts = jax.random.randint(
-            kr, (self.roots,), 0, self.csr_topo.node_count, dtype=jnp.int32
+        return _saint_rw_sample(
+            self.topo, self._next_key(), self.roots, self.walk_length,
+            self.deg_cap,
         )
-        visited = _random_walk_jit(self.topo, starts, self.walk_length, kw)
-        nodes = np.unique(np.asarray(visited))
-        nodes = nodes[nodes >= 0].astype(np.int32)
-        padded = np.full(self.budget, -1, dtype=np.int32)
-        padded[: len(nodes)] = nodes
-        return self._extract(jnp.asarray(padded), jnp.int32(len(nodes)))
 
 
 def random_walk(topo, starts, walk_length: int, key):
@@ -236,9 +293,6 @@ def random_walk(topo, starts, walk_length: int, key):
         cur = jnp.where(step >= 0, step, cur)
         out.append(cur)
     return jnp.stack(out, axis=1)
-
-
-_random_walk_jit = jax.jit(random_walk, static_argnums=2)
 
 
 def estimate_saint_norm(sampler, num_iters: int = 50):

@@ -1,0 +1,92 @@
+"""Worker process for the 2-host jax.distributed test (test_multihost.py).
+
+Each worker joins the job via quiver_tpu.parallel.mesh.init_distributed
+(VERDICT r2 item 6 — previously an untested wrapper), then proves:
+
+1. the job formed: process_count == N, global device count == 4*N;
+2. the CSR builder's cross-host determinism claim
+   (native/quiver_host.cpp — stable counting-sort scatter): independent
+   builds of the same COO on each host hash byte-identical, verified by
+   allgathering the digests;
+3. a real cross-process collective works: a jitted global-mesh reduction
+   over an array sharded across both processes' devices.
+
+Prints ONE JSON line with the results; exit 0 iff all checks pass.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from quiver_tpu.parallel.mesh import init_distributed
+
+    init_distributed(f"localhost:{port}", nprocs, pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert len(jax.devices()) == 4 * nprocs, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    # -- cross-host deterministic CSR build --------------------------------
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.utils.graphgen import generate_pareto_graph
+
+    ei = generate_pareto_graph(5000, 8.0, seed=7)
+    topo = CSRTopo(edge_index=ei)
+    h = hashlib.sha256()
+    for arr in (topo.indptr, topo.indices, topo.eid):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    # ship the digest as uint32 words: jax default-32-bit silently truncates
+    # uint64 payloads in the allgather
+    digest_words = np.frombuffer(h.digest(), dtype=np.uint32)
+
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(digest_words)
+    ).reshape(nprocs, -1)
+    ok_csr = bool((gathered == digest_words[None, :]).all())
+
+    # -- cross-process sharded reduction -----------------------------------
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()  # (data=4*nprocs, feature=1) spanning both processes
+    n = 4 * nprocs
+    data = np.arange(n, dtype=np.float32)
+    sharding = NamedSharding(mesh, P("data"))
+    x = jax.make_array_from_callback((n,), sharding, lambda idx: data[idx])
+    total = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P())
+    )(x)
+    ok_sum = float(total) == float(data.sum())
+
+    print(json.dumps({
+        "pid": pid,
+        "ok_csr": ok_csr,
+        "ok_sum": ok_sum,
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+    }))
+    sys.exit(0 if (ok_csr and ok_sum) else 1)
+
+
+if __name__ == "__main__":
+    main()

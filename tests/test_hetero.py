@@ -341,3 +341,89 @@ def test_hetero_auto_caps_results_valid():
             indptr, indices = rel.indptr, rel.indices
             for sg, dg in zip(src[:200], dst[:200]):
                 assert sg in indices[indptr[dg]:indptr[dg + 1]]
+
+
+def test_hetero_eid_maps_edges_to_coo_positions():
+    """VERDICT r2 item 8: hetero analogue of the homogeneous e_id oracle
+    (tests/test_sampler_api.py::test_eid_threading_maps_edges_to_coo_positions)
+    — with_eid=True must thread relation-local COO edge positions through
+    every Adj: the COO edge at position e_id is exactly (src_global,
+    dst_global)."""
+    topo, edges, _ = _toy_schema(seed=5)
+    sampler = HeteroGraphSampler(
+        topo, [4, 3], input_type="paper", seed=2, with_eid=True
+    )
+    out = sampler.sample(np.arange(24))
+    assert int(out.overflow) == 0
+    n_id = {t: np.asarray(v) for t, v in out.n_id.items()}
+    checked = 0
+    for layer in out.adjs:
+        for et, adj in layer.adjs.items():
+            s_t, _, d_t = et
+            assert adj.e_id is not None
+            e_id = np.asarray(adj.e_id)
+            col, row = np.asarray(adj.edge_index)
+            valid = col >= 0
+            assert np.array_equal(e_id >= 0, valid)
+            ei = edges[et]
+            src_global = n_id[s_t][col[valid]]
+            dst_global = n_id[d_t][row[valid]]
+            assert np.array_equal(ei[0, e_id[valid]], src_global)
+            assert np.array_equal(ei[1, e_id[valid]], dst_global)
+            checked += int(valid.sum())
+    assert checked > 50
+
+
+def test_hetero_weighted_relation_biases_draws():
+    """VERDICT r2 item 8: weighted relations must thread through the typed
+    sampler. Construction: one dst paper with many cite-sources where a
+    single source holds ~all the weight — weighted draws must concentrate on
+    it; an unweighted control must not."""
+    n_paper, n_author = 40, 8
+    hub_dst, hot_src = 0, 7
+    src = np.arange(1, 31)  # papers 1..30 all cite paper 0
+    cites = np.stack([src, np.zeros_like(src)])
+    writes = np.stack([
+        np.random.default_rng(0).integers(0, n_author, 60),
+        np.random.default_rng(1).integers(0, n_paper, 60),
+    ])
+    topo = HeteroCSRTopo(
+        {"paper": n_paper, "author": n_author},
+        {("paper", "cites", "paper"): cites,
+         ("author", "writes", "paper"): writes},
+    )
+    w = np.full(cites.shape[1], 1e-4, np.float32)
+    w[src == hot_src] = 1.0
+    topo.set_edge_weight(("paper", "cites", "paper"), w)
+    assert topo.weighted_edge_types == [("paper", "cites", "paper")]
+
+    def hot_rate(weighted):
+        s = HeteroGraphSampler(
+            topo, [1], input_type="paper", seed=3, weighted=weighted,
+            seed_capacity=128,
+        )
+        hits = draws = 0
+        for i in range(60):
+            out = s.sample(np.asarray([hub_dst]))
+            adj = out.adjs[0].adjs[("paper", "cites", "paper")]
+            col, row = np.asarray(adj.edge_index)
+            ids = np.asarray(out.n_id["paper"])[col[(col >= 0) & (row == 0)]]
+            hits += int((ids == hot_src).sum())
+            draws += int(((col >= 0) & (row == 0)).sum())
+        return hits / max(draws, 1)
+
+    assert hot_rate(True) > 0.9  # ~all weight on the hot edge
+    assert hot_rate(False) < 0.3  # uniform control: 1/30 expected
+
+
+def test_hetero_weighted_validation():
+    topo, _, _ = _toy_schema()
+    with pytest.raises(ValueError, match="edge weights"):
+        HeteroGraphSampler(topo, [2], input_type="paper", weighted=True)
+    with pytest.raises(ValueError, match="edge weights"):
+        HeteroGraphSampler(
+            topo, [2], input_type="paper",
+            weighted=[("paper", "cites", "paper")],
+        )
+    with pytest.raises(ValueError, match="unknown relation"):
+        topo.set_edge_weight(("x", "y", "z"), np.ones(3))

@@ -1,0 +1,58 @@
+"""Multi-host smoke: 2 separate processes form a jax.distributed job.
+
+VERDICT r2 item 6: ``init_distributed`` (parallel/mesh.py) was an untested
+wrapper and the native CSR builder's cross-host byte-identical claim
+(native/quiver_host.cpp) was asserted, never exercised in a multi-process
+setting. Here two real OS processes rendezvous over a localhost
+coordinator (CPU backend, 4 virtual devices each), independently build the
+same graph, allgather their CSR digests, and run a jitted reduction over a
+mesh spanning both processes. See tests/distributed_worker.py for the
+checks each worker performs.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_job():
+    port = _free_port()
+    nprocs = 2
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(nprocs), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for i in range(nprocs)
+    ]
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            line = [l for l in out.splitlines() if l.startswith("{")][-1]
+            results.append(json.loads(line))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert len(results) == nprocs
+    for r in results:
+        assert r["ok_csr"], "CSR builds diverged across hosts"
+        assert r["ok_sum"], "cross-process sharded reduction wrong"
+        assert r["process_count"] == nprocs
+        assert r["global_devices"] == 4 * nprocs

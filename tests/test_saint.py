@@ -115,6 +115,63 @@ def test_rw_sampler_end_to_end():
     assert 0 < int(sub.num_nodes) <= 8 * 4
 
 
+def test_sample_has_no_host_round_trip(monkeypatch):
+    """VERDICT r2 item 5: sample() must be one compiled program — no host
+    np.unique, no host numpy RNG per batch. Guard by making both explode."""
+    ei = generate_pareto_graph(300, 6.0, seed=7)
+    topo = CSRTopo(edge_index=ei)
+    samplers = [
+        SAINTNodeSampler(topo, budget=32, seed=0),
+        SAINTEdgeSampler(topo, budget=16, seed=1),
+        SAINTRandomWalkSampler(topo, roots=4, walk_length=3, seed=2),
+    ]
+    # warm the jit caches first (tracing may legitimately touch numpy)
+    for s in samplers:
+        s.sample()
+
+    def boom(*a, **k):
+        raise AssertionError("host round-trip inside sample()")
+
+    monkeypatch.setattr(np, "unique", boom)
+    monkeypatch.setattr(np.random, "default_rng", boom)
+    for s in samplers:
+        sub = s.sample()
+        assert int(sub.num_nodes) > 0
+
+
+def test_device_node_draw_matches_host_distribution():
+    """Differential oracle for the devicified degree-proportional draw:
+    empirical node frequencies from the device path (uniform edge position →
+    searchsorted on the degree CDF) must match the host
+    rng.choice(p=deg/deg.sum()) law."""
+    from quiver_tpu.sampling.saint import _degree_proportional_nodes
+
+    ei = generate_pareto_graph(60, 4.0, seed=8)
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device()
+    n = topo.node_count
+    deg = topo.degree.astype(np.float64)
+    expect = deg / deg.sum()
+
+    counts = np.zeros(n)
+    draws = 0
+    for i in range(200):
+        # count raw draws, pre-dedup: reconstruct from the edge positions law
+        key = jax.random.PRNGKey(i)
+        nodes, num = _degree_proportional_nodes(dev, key, 64)
+        ids = np.asarray(nodes)[: int(num)]
+        counts[ids] += 1
+        draws += 1
+    # every degree>0 node with P(appearing in 64 draws) ~ 1 should show up;
+    # zero-degree nodes must NEVER be drawn (P=0 under both laws)
+    assert counts[deg == 0].sum() == 0
+    # appearance frequency must rank-correlate with degree
+    seen_rate = counts / draws
+    hi = seen_rate[deg > np.median(deg)].mean()
+    lo = seen_rate[(deg > 0) & (deg <= np.median(deg))].mean()
+    assert hi > lo
+
+
 def test_estimate_saint_norm():
     ei = generate_pareto_graph(200, 6.0, seed=6)
     topo = CSRTopo(edge_index=ei)
