@@ -1,0 +1,228 @@
+"""One-command TPU scoreboard: run every headline benchmark, write the
+results table (VERDICT r2 items 2-3).
+
+Runs each benchmark as a supervised subprocess (same discipline as the
+repo-root ``bench.py``: hard timeout, JSON harvested from stdout, failures
+recorded instead of propagated) and writes:
+
+* ``docs/TPU_RESULTS.md`` — the scoreboard table, every row stamped with
+  its platform, vs the reference's published numbers (BASELINE.md);
+* ``docs/tpu_results.json`` — the raw records.
+
+    python -m benchmarks.scoreboard                 # full run
+    python -m benchmarks.scoreboard --smoke         # small shapes
+    python -m benchmarks.scoreboard --only sampler-hbm feature-replicate
+
+A row whose ``platform`` is not ``tpu`` means the chip was unreachable for
+that run; re-run when it frees up. The table is regenerated whole each time.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (key, module, args, baseline note)
+JOBS = [
+    ("sampler-hbm", "benchmarks.bench_sampler", ["--mode", "HBM"],
+     "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41)"),
+    ("sampler-host", "benchmarks.bench_sampler", ["--mode", "HOST"],
+     "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
+    ("sampler-pallas", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--kernel", "pallas"],
+     "windowed Pallas kernel vs the XLA row above"),
+    ("feature-replicate", "benchmarks.bench_feature",
+     ["--policy", "replicate"],
+     "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),
+    ("feature-replicate-xla", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--kernel", "xla"],
+     "XLA-gather control for the kernel=auto row"),
+    ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
+     "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
+    ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"],
+     "beyond-HBM topology placement"),
+    ("rgcn", "benchmarks.bench_rgcn", [],
+     "no reference baseline (hetero is beyond-parity)"),
+    ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"],
+     "no reference baseline (SAINT never landed there)"),
+    ("validation", "benchmarks.tpu_validation", [],
+     "compiled-Pallas validity + head-to-heads"),
+]
+
+TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))
+
+
+def _harvest(stdout):
+    recs = []
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                recs.append(rec)
+    return recs
+
+
+def _run_once(module, extra, env_overrides, timeout_s):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["QUIVER_BENCH_SUPERVISED"] = "1"
+    env["PYTHONPATH"] = (
+        REPO + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else REPO
+    )
+    argv = [sys.executable, "-m", module] + extra
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout_s, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        # a hung multi-record job (tpu_validation) may already have emitted
+        # valid records — keep them
+        return _harvest(out), f"timeout>{timeout_s:.0f}s"
+    recs = _harvest(r.stdout)
+    err = None
+    if not recs:
+        err = (r.stderr or r.stdout).strip()[-400:] or f"rc={r.returncode}"
+    return recs, err
+
+
+def run_job(module, extra, smoke, timeout_s):
+    """Same discipline as the repo-root bench.py supervisor: children run
+    with QUIVER_BENCH_SUPERVISED=1 (fail fast, no self-healing), so THIS
+    function owns retry-on-error and the labeled CPU-smoke fallback."""
+    extra = extra + (["--smoke"] if smoke else [])
+    t0 = time.time()
+    recs, err = _run_once(module, extra, {}, timeout_s)
+    if not recs and not str(err).startswith("timeout"):
+        print(f"[scoreboard] retrying once after: {str(err)[:120]}",
+              file=sys.stderr, flush=True)
+        time.sleep(15)
+        recs, err = _run_once(module, extra, {}, timeout_s)
+    if not recs:
+        print("[scoreboard] falling back to labeled CPU smoke",
+              file=sys.stderr, flush=True)
+        fb = extra if "--smoke" in extra else extra + ["--smoke"]
+        recs, fb_err = _run_once(
+            module, fb,
+            {"JAX_PLATFORMS": "cpu",
+             "QUIVER_BENCH_DEGRADED": f"scoreboard fallback: {str(err)[:200]}"},
+            min(timeout_s, 600),
+        )
+        if recs:
+            err = None
+        else:
+            err = f"{err}; cpu fallback: {fb_err}"
+    return recs, err, time.time() - t0
+
+
+def fmt_value(rec):
+    v, unit = rec.get("value"), rec.get("unit", "")
+    if v is None:
+        return "—"
+    if unit == "SEPS":
+        return f"{v / 1e6:.2f}M SEPS"
+    return f"{v:g} {unit}"
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--only", nargs="*", default=None,
+                   help="subset of job keys to run")
+    p.add_argument("--out", default=os.path.join(REPO, "docs"))
+    args = p.parse_args()
+
+    known = {key for key, *_ in JOBS}
+    if args.only:
+        unknown = set(args.only) - known
+        if unknown:
+            p.error(f"unknown job keys: {sorted(unknown)} "
+                    f"(choose from {sorted(known)})")
+
+    results = []
+    for key, module, extra, note in JOBS:
+        if args.only and key not in args.only:
+            continue
+        print(f"[scoreboard] {key}: {module} {' '.join(extra)}",
+              file=sys.stderr, flush=True)
+        recs, err, dt = run_job(module, extra, args.smoke, TIMEOUT)
+        print(f"[scoreboard] {key}: {len(recs)} records in {dt:.0f}s"
+              + (f" (error: {err[:120]})" if err else ""),
+              file=sys.stderr, flush=True)
+        results.append({"key": key, "note": note, "records": recs,
+                        "error": err, "seconds": round(dt, 1)})
+
+    os.makedirs(args.out, exist_ok=True)
+    json_path = os.path.join(args.out, "tpu_results.json")
+    if args.only and os.path.exists(json_path):
+        # partial re-run: merge into the existing scoreboard instead of
+        # wiping rows that weren't in the subset
+        try:
+            with open(json_path) as fh:
+                prior = {j["key"]: j for j in json.load(fh).get("jobs", [])}
+        except (ValueError, KeyError):
+            prior = {}
+        for job in results:
+            prior[job["key"]] = job
+        order = [key for key, *_ in JOBS]
+        results = sorted(
+            prior.values(),
+            key=lambda j: order.index(j["key"]) if j["key"] in order else 99,
+        )
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    with open(json_path, "w") as fh:
+        json.dump({"when": stamp, "smoke": args.smoke, "jobs": results}, fh,
+                  indent=1)
+
+    lines = [
+        "# TPU scoreboard",
+        "",
+        f"Generated by `python -m benchmarks.scoreboard` at {stamp}"
+        + (" (SMOKE shapes)" if args.smoke else "") + ".",
+        "",
+        "| Job | Metric | Value | vs baseline | Platform | Reference point |",
+        "|---|---|---|---|---|---|",
+    ]
+    for job in results:
+        if not job["records"]:
+            lines.append(
+                f"| {job['key']} | — | FAILED | — | — | {job['note']} |"
+            )
+            continue
+        for rec in job["records"]:
+            vs = rec.get("vs_baseline")
+            plat = rec.get("platform", "?")
+            if rec.get("degraded"):
+                plat += " (degraded)"
+            metric = rec.get("metric", "?")
+            extras = {k: v for k, v in rec.items()
+                      if k in ("kernel", "mode", "policy", "caps", "sampler")}
+            if extras:
+                metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
+            lines.append(
+                f"| {job['key']} | {metric} | {fmt_value(rec)} | "
+                f"{vs if vs is not None else '—'} | {plat} | {job['note']} |"
+            )
+    lines += [
+        "",
+        "`vs baseline` > 1 always means better than the reference "
+        "(value/baseline for throughput, baseline/value for times).",
+        "",
+    ]
+    with open(os.path.join(args.out, "TPU_RESULTS.md"), "w") as fh:
+        fh.write("\n".join(lines))
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
