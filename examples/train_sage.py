@@ -52,6 +52,28 @@ def synthetic_dataset(args) -> GraphDataset:
     )
 
 
+def evaluate_layerwise(model, params, topo, feature, labels_all, idx):
+    """Full-neighbor layer-wise inference over the whole graph — the
+    reference's ``model.inference`` evaluation path (reddit_quiver.py:68-92),
+    rebuilt as chunked segment aggregation (models/inference.py). Features
+    are streamed back out of the tiered store in blocks, so the cold tier is
+    exercised too."""
+    from quiver_tpu.models.inference import sage_layerwise_inference
+
+    n, f = feature.shape
+    block = 65536
+    # preallocate + in-place block writes: a concatenate of held blocks
+    # would transiently double the (N, F) footprint
+    x_all = jnp.zeros((n, f), jnp.float32)
+    for lo in range(0, n, block):
+        hi = min(lo + block, n)
+        x_all = x_all.at[lo:hi].set(feature[jnp.arange(lo, hi)])
+    logp = sage_layerwise_inference(model, params, topo, x_all)
+    idx = jnp.asarray(idx)
+    pred = jnp.argmax(logp[idx], axis=-1)
+    return float((pred == labels_all[idx]).mean())
+
+
 def evaluate(sampler, feature, eval_step, params, labels_all, idx, batch):
     """Batched accuracy over a node-id split (reference test() loop parity)."""
     correct = total = 0
@@ -87,6 +109,12 @@ def main(argv=None):
     p.add_argument("--cache-ratio", type=float, default=0.2)
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--eval", default="sampled", choices=["sampled", "layerwise"],
+        help="test-time evaluation: batched sampled fanout (fast) or "
+        "full-neighbor layer-wise inference over all edges (the "
+        "reference's model.inference path)",
+    )
     args = p.parse_args(argv)
 
     if args.dataset == "synthetic":
@@ -149,10 +177,15 @@ def main(argv=None):
             f"({time.time() - t0:.1f}s)"
         )
 
-    test_acc = evaluate(
-        sampler, feature, eval_step, params, labels_all, np.asarray(ds.test_idx),
-        args.batch,
-    )
+    if args.eval == "layerwise":
+        test_acc = evaluate_layerwise(
+            model, params, topo, feature, labels_all, np.asarray(ds.test_idx)
+        )
+    else:
+        test_acc = evaluate(
+            sampler, feature, eval_step, params, labels_all,
+            np.asarray(ds.test_idx), args.batch,
+        )
     line = f"Test Acc: {test_acc:.4f}"
     if "feature_bayes_acc" in ds.meta:
         line += f" (feature-only Bayes: {ds.meta['feature_bayes_acc']:.4f})"

@@ -1,0 +1,20 @@
+"""TPU-native GNN model layer (the reference delegates this to PyG/DGL).
+
+Models consume the sampler's padded Adj contract directly; see
+models/layers.py for the segment-op primitives and models/inference.py for
+full-neighbor layer-wise inference (the reference's ``model.inference``
+evaluation path, examples/pyg/reddit_quiver.py:68-92)."""
+
+from .gat import GAT
+from .inference import full_neighbor_mean, sage_layerwise_inference
+from .rgcn import RGCN
+from .sage import GraphSAGE, SAGEConv
+
+__all__ = [
+    "GAT",
+    "GraphSAGE",
+    "RGCN",
+    "SAGEConv",
+    "full_neighbor_mean",
+    "sage_layerwise_inference",
+]

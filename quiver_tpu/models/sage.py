@@ -23,15 +23,23 @@ __all__ = ["SAGEConv", "GraphSAGE"]
 class SAGEConv(nn.Module):
     features: int
 
-    @nn.compact
+    def setup(self):
+        # attribute names keep the original compact-module param tree
+        # ("lin_l"/"lin_r"), so existing checkpoints/params stay valid
+        self.lin_l = nn.Dense(self.features, name="lin_l")
+        self.lin_r = nn.Dense(self.features, use_bias=False, name="lin_r")
+
+    def combine(self, agg, x_self):
+        """W_l · aggregated-neighbors + W_r · x_self — exposed separately so
+        full-graph layer-wise inference (models/inference.py) can reuse the
+        trained weights on aggregates it computed itself."""
+        return self.lin_l(agg) + self.lin_r(x_self)
+
     def __call__(self, x, edge_index, num_dst: int):
         src, dst = edge_index[0], edge_index[1]
         msgs, valid = gather_src(x, src)
         agg = segment_mean_aggregate(msgs, jnp.clip(dst, 0), valid, num_dst)
-        x_self = x[:num_dst]
-        return nn.Dense(self.features, name="lin_l")(agg) + nn.Dense(
-            self.features, use_bias=False, name="lin_r"
-        )(x_self)
+        return self.combine(agg, x[:num_dst])
 
 
 class GraphSAGE(nn.Module):
