@@ -32,9 +32,111 @@ def main():
         help="sampling kernel: exact XLA stratified sampler or the Pallas "
         "windowed-DMA kernel (HBM mode, unweighted)",
     )
+    p.add_argument(
+        "--caps",
+        default="auto",
+        choices=["auto", "worst"],
+        help="frontier capacities: auto right-sizes every layer from the "
+        "first batch's observed uniques (results stay exact — overflow "
+        "triggers a regrow+resample); worst pads to the theoretical bound, "
+        "which on a power-law graph means sorting node_count-sized arrays "
+        "in every reindex (SURVEY §7.4.2)",
+    )
+    p.add_argument(
+        "--stages",
+        action="store_true",
+        help="also emit a per-layer sample/reindex stage profile (one JSON "
+        "line per stage) — the attribution the headline number needs when "
+        "it falls short of baseline",
+    )
     p.set_defaults(warmup=25, iters=50)
     args = p.parse_args()
     run_guarded(lambda: _body(args), args)
+
+
+def _stage_profile(args, sampler, topo, reps: int = 30):
+    """Time each layer's sample and reindex stages as separate compiled
+    programs on realistic frontier inputs (the fused program hides the
+    split; this attributes it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.reindex import reindex_layer
+    from quiver_tpu.ops.sample import sample_layer
+
+    cap = args.batch
+    caps = sampler._caps_for(cap)
+    rng = np.random.default_rng(args.seed + 7)
+    padded = np.full(cap, -1, dtype=np.int32)
+    seeds = rng.integers(0, topo.node_count, args.batch)
+    padded[: args.batch] = seeds
+    cur = jnp.asarray(padded)
+    cur_n = jnp.int32(args.batch)
+    key = jax.random.PRNGKey(args.seed + 7)
+
+    def timed(fn, *fn_args):
+        out = fn(*fn_args)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(reps):
+            t0 = time.time()
+            out = fn(*fn_args)
+            jax.block_until_ready(out)
+            ts.append(time.time() - t0)
+        ts = np.sort(ts)
+        k = max(1, len(ts) // 10)
+        return out, float(np.mean(ts[k:-k]) * 1e3)
+
+    use_pallas = sampler.kernel == "pallas"
+    if use_pallas:
+        from quiver_tpu.ops.pallas.sample import (
+            DEFAULT_WINDOW,
+            sample_layer_windowed,
+        )
+
+        # same trace-time fallback rule the fused program applies
+        use_pallas = sampler.topo.indices.shape[0] >= DEFAULT_WINDOW
+
+    for l, k in enumerate(sampler.sizes):
+        key, sub = jax.random.split(key)
+        if use_pallas:
+            f_sample = jax.jit(
+                lambda t, c, n, kk, fan=k: sample_layer_windowed(
+                    t, c, n, fan, kk
+                )
+            )
+        else:
+            f_sample = jax.jit(
+                lambda t, c, n, kk, fan=k: sample_layer(t, c, n, fan, kk)
+            )
+        (nbr, counts), t_sample = timed(f_sample, sampler.topo, cur, cur_n, sub)
+        f_reindex = jax.jit(
+            lambda c, n, nb, fc=caps[l]: reindex_layer(c, n, nb, fc)
+        )
+        (frontier, n_frontier, _, _), t_reindex = timed(
+            f_reindex, cur, cur_n, nbr
+        )
+        emit(
+            "sampler-stage-ms",
+            t_sample,
+            "ms",
+            None,
+            layer=l,
+            stage="sample",
+            kernel="pallas" if use_pallas else "xla",
+            fanout=k,
+            frontier_in=int(cur.shape[0]),
+        )
+        emit(
+            "sampler-stage-ms",
+            t_reindex,
+            "ms",
+            None,
+            layer=l,
+            stage="reindex",
+            frontier_cap=int(caps[l]),
+        )
+        cur, cur_n = frontier, n_frontier
 
 
 def _body(args):
@@ -46,6 +148,7 @@ def _body(args):
     sampler = GraphSageSampler(
         topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
         seed=args.seed, kernel=args.kernel,
+        frontier_caps="auto" if args.caps == "auto" else None,
     )
     rng = np.random.default_rng(args.seed)
 
@@ -73,7 +176,11 @@ def _body(args):
         kernel=args.kernel,
         fanout=args.fanout,
         batch=args.batch,
+        caps=args.caps,
     )
+
+    if getattr(args, "stages", False):
+        _stage_profile(args, sampler, topo)
 
 
 if __name__ == "__main__":

@@ -29,7 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (key, module, args, baseline note)
 JOBS = [
-    ("sampler-hbm", "benchmarks.bench_sampler", ["--mode", "HBM"],
+    ("sampler-hbm", "benchmarks.bench_sampler", ["--mode", "HBM", "--stages"],
      "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41)"),
     ("sampler-host", "benchmarks.bench_sampler", ["--mode", "HOST"],
      "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
@@ -206,7 +206,8 @@ def main():
                 plat += " (degraded)"
             metric = rec.get("metric", "?")
             extras = {k: v for k, v in rec.items()
-                      if k in ("kernel", "mode", "policy", "caps", "sampler")}
+                      if k in ("kernel", "mode", "policy", "caps", "sampler",
+                               "layer", "stage")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

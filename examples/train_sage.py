@@ -60,14 +60,14 @@ def evaluate_layerwise(model, params, topo, feature, labels_all, idx):
     exercised too."""
     from quiver_tpu.models.inference import sage_layerwise_inference
 
-    n, f = feature.shape
+    n, _ = feature.shape
     block = 65536
-    # preallocate + in-place block writes: a concatenate of held blocks
-    # would transiently double the (N, F) footprint
-    x_all = jnp.zeros((n, f), jnp.float32)
-    for lo in range(0, n, block):
-        hi = min(lo + block, n)
-        x_all = x_all.at[lo:hi].set(feature[jnp.arange(lo, hi)])
+    # one concatenate = one full copy at a transient 2x footprint; eager
+    # .at[].set would copy the whole array once per block (O(N^2) traffic)
+    x_all = jnp.concatenate([
+        feature[jnp.arange(lo, min(lo + block, n))]
+        for lo in range(0, n, block)
+    ])
     logp = sage_layerwise_inference(model, params, topo, x_all)
     idx = jnp.asarray(idx)
     pred = jnp.argmax(logp[idx], axis=-1)

@@ -24,23 +24,31 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..core.config import SampleMode
+from ..core.memory import to_pinned_host
+from ..ops.sample import staged_gather
 from .sage import SAGEConv
 
 __all__ = ["full_neighbor_mean", "sage_layerwise_inference"]
 
 
-@functools.partial(jax.jit, donate_argnums=0, static_argnames=("chunk",))
-def _accumulate_chunk(acc, x_all, indptr, indices, e0, chunk: int):
+@functools.partial(
+    jax.jit, donate_argnums=0, static_argnames=("chunk", "host")
+)
+def _accumulate_chunk(acc, x_all, indptr, indices, e0, chunk: int,
+                      host: bool):
     """Scatter-add one edge chunk's source features into the accumulator.
 
     Row (destination) ids are recovered on device from ``indptr`` by binary
     search — no E-sized host-materialized row array. Out-of-range tail lanes
-    (last chunk) are masked to a throwaway row.
+    (last chunk) are masked to a throwaway row. With ``host`` the edge
+    array lives in pinned host memory and each chunk's ids stage through
+    host compute (the beyond-HBM placement).
     """
     E = indices.shape[0]
     epos = e0 + jnp.arange(chunk, dtype=indptr.dtype)
     in_range = epos < E
-    src = indices[jnp.where(in_range, epos, 0)]
+    src = staged_gather(indices, jnp.where(in_range, epos, 0), host)
     dst = (
         jnp.searchsorted(indptr, epos, side="right").astype(jnp.int32) - 1
     )
@@ -50,37 +58,52 @@ def _accumulate_chunk(acc, x_all, indptr, indices, e0, chunk: int):
     return acc.at[dst].add(msgs)
 
 
-def _neighbor_mean_dev(indptr, indices, x_all, chunk: int):
-    """full_neighbor_mean body on already-device-resident CSR arrays."""
+def _neighbor_mean_dev(indptr, indices, x_all, chunk: int,
+                       host: bool = False):
+    """full_neighbor_mean body on already-placed CSR arrays."""
     n, f = x_all.shape
     E = indices.shape[0]
     acc = jnp.zeros((n + 1, f), x_all.dtype)  # +1 = masked-lane bucket
     for e0 in range(0, max(E, 1), chunk):
         acc = _accumulate_chunk(
             acc, x_all, indptr, indices,
-            jnp.asarray(e0, indptr.dtype), chunk,
+            jnp.asarray(e0, indptr.dtype), chunk, host,
         )
     deg = jnp.maximum(jnp.diff(indptr).astype(x_all.dtype), 1.0)
     return acc[:n] / deg[:, None]
 
 
-def full_neighbor_mean(topo, x_all, chunk: int = 1 << 21):
+def _place(topo, mode):
+    """(indptr_dev, indices, host_flag): HBM puts everything on device;
+    HOST keeps the big edge array in pinned host memory (falls back to
+    device where the platform has no pinned_host space)."""
+    mode = SampleMode.parse(mode)
+    indptr = jnp.asarray(topo.indptr)
+    if mode == SampleMode.HOST:
+        indices, host = to_pinned_host(topo.indices)
+        return indptr, indices, host
+    return indptr, jnp.asarray(topo.indices), False
+
+
+def full_neighbor_mean(topo, x_all, chunk: int = 1 << 21,
+                       mode: str | SampleMode = SampleMode.HBM):
     """Mean of ALL neighbors' features for every node: (N, F) -> (N, F).
 
-    ``topo`` is a host CSRTopo (its arrays are placed on device once —
-    indptr/indices must fit in HBM alongside two (N, F) buffers). Equivalent
-    to ``D^-1 A X`` with mean over incoming CSR neighbors; zero-degree rows
-    aggregate to zeros, matching segment_mean_aggregate's empty-segment
-    convention.
+    ``topo`` is a host CSRTopo. ``mode="HBM"`` places the edge array on
+    device (needs HBM alongside two (N, F) buffers); ``mode="HOST"`` keeps
+    it in pinned host memory and stages each chunk's ids through host
+    compute — graphs beyond HBM stay evaluable. Equivalent to ``D^-1 A X``
+    with mean over incoming CSR neighbors; zero-degree rows aggregate to
+    zeros, matching segment_mean_aggregate's empty-segment convention.
     """
-    return _neighbor_mean_dev(
-        jnp.asarray(topo.indptr), jnp.asarray(topo.indices),
-        jnp.asarray(x_all), chunk,
-    )
+    indptr, indices, host = _place(topo, mode)
+    return _neighbor_mean_dev(indptr, indices, jnp.asarray(x_all), chunk,
+                              host)
 
 
 def sage_layerwise_inference(model, params, topo, x_all,
-                             chunk: int = 1 << 21):
+                             chunk: int = 1 << 21,
+                             mode: str | SampleMode = SampleMode.HBM):
     """Layer-wise full-neighbor GraphSAGE inference (reference
     reddit_quiver.py:68-92 parity): returns (N, num_classes) log-probs for
     EVERY node, using all edges at every layer.
@@ -92,16 +115,16 @@ def sage_layerwise_inference(model, params, topo, x_all,
       topo: host CSRTopo.
       x_all: (N, F) input features (will be placed on device).
       chunk: edges per aggregation program.
+      mode: "HBM" or "HOST" (pinned-host edge array for beyond-HBM graphs).
     """
     x = jnp.asarray(x_all)
     # place the (possibly multi-GB) CSR arrays once, not once per layer
-    indptr = jnp.asarray(topo.indptr)
-    indices = jnp.asarray(topo.indices)
+    indptr, indices, host = _place(topo, mode)
     for i in range(model.num_layers):
         feats = (
             model.num_classes if i == model.num_layers - 1 else model.hidden
         )
-        agg = _neighbor_mean_dev(indptr, indices, x, chunk)
+        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host)
         conv = SAGEConv(feats)
         x = conv.apply(
             {"params": params[f"conv{i}"]}, agg, x, method=SAGEConv.combine

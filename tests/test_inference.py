@@ -56,6 +56,17 @@ def test_zero_degree_rows_aggregate_to_zero():
     assert np.allclose(got[1], 1) and np.allclose(got[2], 1)
 
 
+def test_full_neighbor_mean_host_mode_matches_hbm():
+    """Beyond-HBM placement: pinned-host edge array + staged chunk gathers
+    must agree exactly with the HBM path."""
+    ei = generate_pareto_graph(250, 6.0, seed=6)
+    topo = CSRTopo(edge_index=ei)
+    x = np.random.default_rng(7).normal(size=(250, 6)).astype(np.float32)
+    hbm = np.asarray(full_neighbor_mean(topo, x, chunk=101))
+    host = np.asarray(full_neighbor_mean(topo, x, chunk=101, mode="HOST"))
+    np.testing.assert_allclose(host, hbm, rtol=1e-6)
+
+
 def test_layerwise_inference_matches_full_fanout_sampled_model():
     """End-to-end oracle: with fanout -1 (every neighbor taken) the sampled
     model's seed predictions equal the whole-graph layer-wise pass."""
