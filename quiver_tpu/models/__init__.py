@@ -6,7 +6,11 @@ full-neighbor layer-wise inference (the reference's ``model.inference``
 evaluation path, examples/pyg/reddit_quiver.py:68-92)."""
 
 from .gat import GAT
-from .inference import full_neighbor_mean, sage_layerwise_inference
+from .inference import (
+    full_neighbor_mean,
+    gat_layerwise_inference,
+    sage_layerwise_inference,
+)
 from .rgcn import RGCN
 from .sage import GraphSAGE, SAGEConv
 
@@ -16,5 +20,6 @@ __all__ = [
     "RGCN",
     "SAGEConv",
     "full_neighbor_mean",
+    "gat_layerwise_inference",
     "sage_layerwise_inference",
 ]

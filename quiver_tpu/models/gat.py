@@ -41,25 +41,50 @@ class GATConv(nn.Module):
     concat: bool = True
     negative_slope: float = 0.2
 
-    @nn.compact
+    def setup(self):
+        # setup-style (attribute/param names keep the original compact
+        # module's tree: lin/att_l/att_r/bias) so full-graph layer-wise
+        # inference (models/inference.py) can reuse trained weights through
+        # the project/finish methods
+        H, F = self.heads, self.features
+        self.lin = nn.Dense(H * F, use_bias=False, name="lin")
+        self.att_l = self.param(
+            "att_l", nn.initializers.glorot_uniform(), (H, F)
+        )
+        self.att_r = self.param(
+            "att_r", nn.initializers.glorot_uniform(), (H, F)
+        )
+        self.bias = self.param(
+            "bias", nn.initializers.zeros,
+            (H * F,) if self.concat else (F,),
+        )
+
+    def project(self, x):
+        """Node-level halves of the attention: per-head projections plus the
+        a_l·Wh / a_r·Wh summands (per-edge logits are their sum) — avoids
+        forming the (E, H, 2F) concat the naive formulation would need."""
+        H, F = self.heads, self.features
+        h_all = self.lin(x).reshape(x.shape[0], H, F)
+        alpha_src = (h_all * self.att_l).sum(-1)  # (N, H)
+        alpha_dst = (h_all * self.att_r).sum(-1)  # (N, H)
+        return h_all, alpha_src, alpha_dst
+
+    def finish(self, out):
+        """(num_dst, H, F) aggregated messages -> layer output (concat or
+        head-mean, + bias)."""
+        num_dst = out.shape[0]
+        if self.concat:
+            return out.reshape(num_dst, self.heads * self.features) + self.bias
+        return out.mean(axis=1) + self.bias
+
     def __call__(self, x, edge_index, num_dst: int):
         src, dst = edge_index[0], edge_index[1]
         valid = (src >= 0) & (dst >= 0)
         src_safe = jnp.clip(src, 0)
         dst_safe = jnp.where(valid, dst, num_dst)  # overflow segment
 
-        H, F = self.heads, self.features
-        # one dense projection for all heads: (N, in) -> (N, H, F)
-        w = nn.Dense(H * F, use_bias=False, name="lin")
-        h_all = w(x).reshape(x.shape[0], H, F)
-        h_dst = h_all[:num_dst]
-
-        a_l = self.param("att_l", nn.initializers.glorot_uniform(), (H, F))
-        a_r = self.param("att_r", nn.initializers.glorot_uniform(), (H, F))
-        # per-node attention halves, then per-edge sum — avoids forming the
-        # (E, H, 2F) concat the naive formulation would need
-        alpha_src = (h_all * a_l).sum(-1)  # (N, H)
-        alpha_dst = (h_dst * a_r).sum(-1)  # (num_dst, H)
+        h_all, alpha_src, alpha_dst = self.project(x)
+        alpha_dst = alpha_dst[:num_dst]
 
         logits = alpha_src[src_safe] + alpha_dst[jnp.clip(dst, 0, num_dst - 1)]
         logits = nn.leaky_relu(logits, self.negative_slope)  # (E, H)
@@ -68,15 +93,9 @@ class GATConv(nn.Module):
 
         msgs = h_all[src_safe] * alpha[:, :, None]  # (E, H, F)
         msgs = jnp.where(valid[:, None, None], msgs, 0.0)
+        H, F = self.heads, self.features
         out = jnp.zeros((num_dst + 1, H, F), msgs.dtype).at[dst_safe].add(msgs)
-        out = out[:num_dst]
-
-        bias = self.param(
-            "bias", nn.initializers.zeros, (H * F,) if self.concat else (F,)
-        )
-        if self.concat:
-            return out.reshape(num_dst, H * F) + bias
-        return out.mean(axis=1) + bias
+        return self.finish(out[:num_dst])
 
 
 class GAT(nn.Module):

@@ -27,9 +27,32 @@ import jax.numpy as jnp
 from ..core.config import SampleMode
 from ..core.memory import to_pinned_host
 from ..ops.sample import staged_gather
+from .gat import GATConv
 from .sage import SAGEConv
 
-__all__ = ["full_neighbor_mean", "sage_layerwise_inference"]
+__all__ = [
+    "full_neighbor_mean",
+    "sage_layerwise_inference",
+    "gat_layerwise_inference",
+]
+
+
+def _edge_chunk(indptr, indices, e0, chunk: int, n: int, host: bool):
+    """(src, dst, in_range) for edges [e0, e0+chunk).
+
+    Row (destination) ids are recovered on device from ``indptr`` by binary
+    search — no E-sized host-materialized row array. Out-of-range tail
+    lanes (last chunk) are masked to the bucket row ``n``. With ``host``
+    the edge array lives in pinned host memory and each chunk's ids stage
+    through host compute (the beyond-HBM placement).
+    """
+    E = indices.shape[0]
+    epos = e0 + jnp.arange(chunk, dtype=indptr.dtype)
+    in_range = epos < E
+    src = staged_gather(indices, jnp.where(in_range, epos, 0), host)
+    dst = jnp.searchsorted(indptr, epos, side="right").astype(jnp.int32) - 1
+    dst = jnp.where(in_range, jnp.clip(dst, 0, n - 1), n)
+    return src.astype(jnp.int32), dst, in_range
 
 
 @functools.partial(
@@ -37,25 +60,10 @@ __all__ = ["full_neighbor_mean", "sage_layerwise_inference"]
 )
 def _accumulate_chunk(acc, x_all, indptr, indices, e0, chunk: int,
                       host: bool):
-    """Scatter-add one edge chunk's source features into the accumulator.
-
-    Row (destination) ids are recovered on device from ``indptr`` by binary
-    search — no E-sized host-materialized row array. Out-of-range tail lanes
-    (last chunk) are masked to a throwaway row. With ``host`` the edge
-    array lives in pinned host memory and each chunk's ids stage through
-    host compute (the beyond-HBM placement).
-    """
-    E = indices.shape[0]
-    epos = e0 + jnp.arange(chunk, dtype=indptr.dtype)
-    in_range = epos < E
-    src = staged_gather(indices, jnp.where(in_range, epos, 0), host)
-    dst = (
-        jnp.searchsorted(indptr, epos, side="right").astype(jnp.int32) - 1
-    )
+    """Scatter-add one edge chunk's source features into the accumulator."""
     n = acc.shape[0] - 1  # last row is the mask bucket
-    dst = jnp.where(in_range, jnp.clip(dst, 0, n - 1), n)
-    msgs = x_all[src.astype(jnp.int32)]
-    return acc.at[dst].add(msgs)
+    src, dst, _ = _edge_chunk(indptr, indices, e0, chunk, n, host)
+    return acc.at[dst].add(x_all[src])
 
 
 def _neighbor_mean_dev(indptr, indices, x_all, chunk: int,
@@ -99,6 +107,96 @@ def full_neighbor_mean(topo, x_all, chunk: int = 1 << 21,
     indptr, indices, host = _place(topo, mode)
     return _neighbor_mean_dev(indptr, indices, jnp.asarray(x_all), chunk,
                               host)
+
+
+def _edge_logits(alpha_src, alpha_dst, src, dst, negative_slope):
+    logit = alpha_src[src] + alpha_dst[jnp.clip(dst, 0, alpha_dst.shape[0] - 1)]
+    return jax.nn.leaky_relu(logit, negative_slope)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0, static_argnames=("chunk", "host", "slope")
+)
+def _gat_max_chunk(seg_max, a_s, a_d, indptr, indices, e0, chunk, host,
+                   slope):
+    n = seg_max.shape[0] - 1
+    src, dst, _ = _edge_chunk(indptr, indices, e0, chunk, n, host)
+    return seg_max.at[dst].max(_edge_logits(a_s, a_d, src, dst, slope))
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1),
+    static_argnames=("chunk", "host", "slope"),
+)
+def _gat_denom_accum_chunk(num, denom, h_all, seg_max, a_s, a_d, indptr,
+                           indices, e0, chunk, host, slope):
+    """One fused pass updating BOTH the softmax denominator and the
+    weighted-message numerator — the per-edge work (staged gather,
+    searchsorted, logits, exp) is identical, so splitting them would sweep
+    the (possibly pinned-host multi-GB) edge array twice for nothing."""
+    n = num.shape[0] - 1
+    src, dst, _ = _edge_chunk(indptr, indices, e0, chunk, n, host)
+    logit = _edge_logits(a_s, a_d, src, dst, slope)
+    w = jnp.exp(logit - seg_max[dst])  # (chunk, H)
+    return (
+        num.at[dst].add(w[:, :, None] * h_all[src]),
+        denom.at[dst].add(w),
+    )
+
+
+def gat_layerwise_inference(model, params, topo, x_all,
+                            chunk: int = 1 << 20,
+                            mode: str | SampleMode = SampleMode.HBM):
+    """Layer-wise full-neighbor GAT inference — attention over ALL edges.
+
+    Beyond-reference capability (the reference ships layer-wise inference
+    only for SAGE): per layer, two chunked edge passes realize an exact
+    whole-graph segment softmax — (1) per-destination logit max, (2) a
+    fused pass accumulating both the shifted-exp denominator and the
+    weighted-message numerator — then the trained head combine/bias applies
+    via GATConv.finish. Matches the sampled model at full fanout (tested).
+    Zero-in-degree nodes output bias-only rows, the sampled path's
+    convention.
+    """
+    x = jnp.asarray(x_all)
+    indptr, indices, host = _place(topo, mode)
+    n = topo.node_count
+    E = int(topo.edge_count)
+    slope = None
+    for i in range(model.num_layers):
+        last = i == model.num_layers - 1
+        conv = GATConv(
+            features=model.num_classes if last else model.hidden,
+            heads=1 if last else model.heads,
+            concat=not last,
+        )
+        slope = conv.negative_slope
+        p_i = {"params": params[f"conv{i}"]}
+        h_all, a_s, a_d = conv.apply(p_i, x, method=GATConv.project)
+        H = h_all.shape[1]
+
+        e0s = [jnp.asarray(e0, indptr.dtype)
+               for e0 in range(0, max(E, 1), chunk)]
+        seg_max = jnp.full((n + 1, H), -jnp.inf, h_all.dtype)
+        for e0 in e0s:
+            seg_max = _gat_max_chunk(seg_max, a_s, a_d, indptr, indices, e0,
+                                     chunk, host, slope)
+        # empty destinations: keep the shift finite (their denom stays 0)
+        seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+        denom = jnp.zeros((n + 1, H), h_all.dtype)
+        num = jnp.zeros((n + 1, H, h_all.shape[2]), h_all.dtype)
+        for e0 in e0s:
+            num, denom = _gat_denom_accum_chunk(
+                num, denom, h_all, seg_max, a_s, a_d, indptr, indices, e0,
+                chunk, host, slope,
+            )
+        out = num[:n] / jnp.maximum(
+            denom[:n], jnp.finfo(h_all.dtype).tiny
+        )[:, :, None]
+        x = conv.apply(p_i, out, method=GATConv.finish)
+        if not last:
+            x = jax.nn.elu(x)
+    return jax.nn.log_softmax(x, axis=-1)
 
 
 def sage_layerwise_inference(model, params, topo, x_all,

@@ -172,6 +172,23 @@ def test_device_node_draw_matches_host_distribution():
     assert hi > lo
 
 
+def test_saint_training_beats_feature_bayes():
+    """End-to-end acceptance (the SAINT analogue of
+    test_datasets.test_acceptance_sage_beats_feature_bayes): SAINT-subgraph
+    training + layer-wise inference must recover the planted structure."""
+    from examples.train_saint import main
+
+    acc, ds = main([
+        "--dataset", "planted:4000:6",
+        "--steps", "150",
+        "--budget", "512",
+        "--norm-iters", "15",
+    ])
+    bayes = ds.meta["feature_bayes_acc"]
+    assert acc >= 0.85, f"SAINT test acc {acc} below acceptance bar"
+    assert acc >= bayes + 0.15, f"acc {acc} does not clear Bayes {bayes}"
+
+
 def test_estimate_saint_norm():
     ei = generate_pareto_graph(200, 6.0, seed=6)
     topo = CSRTopo(edge_index=ei)
