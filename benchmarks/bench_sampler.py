@@ -180,7 +180,14 @@ def _body(args):
     )
 
     if getattr(args, "stages", False):
-        _stage_profile(args, sampler, topo)
+        # the headline is already emitted — a stage-profile failure must
+        # not take the run down (each stage is a fresh compile, each a
+        # fresh chance at a transient backend error)
+        try:
+            _stage_profile(args, sampler, topo)
+        except Exception as e:  # noqa: BLE001
+            log(f"stage profile failed (headline unaffected): "
+                f"{type(e).__name__}: {str(e)[:200]}")
 
 
 if __name__ == "__main__":

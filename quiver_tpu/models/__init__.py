@@ -9,6 +9,7 @@ from .gat import GAT
 from .inference import (
     full_neighbor_mean,
     gat_layerwise_inference,
+    rgcn_layerwise_inference,
     sage_layerwise_inference,
 )
 from .rgcn import RGCN
@@ -21,5 +22,6 @@ __all__ = [
     "SAGEConv",
     "full_neighbor_mean",
     "gat_layerwise_inference",
+    "rgcn_layerwise_inference",
     "sage_layerwise_inference",
 ]

@@ -99,6 +99,91 @@ def test_gat_layerwise_matches_full_fanout_sampled_model():
     np.testing.assert_allclose(sampled_logp, full_logp, rtol=2e-4, atol=2e-5)
 
 
+def test_gat_layerwise_host_mode_matches_hbm():
+    from quiver_tpu.models.gat import GAT
+
+    ei = generate_pareto_graph(150, 5.0, seed=10)
+    topo = CSRTopo(edge_index=ei)
+    x_all = np.random.default_rng(11).normal(size=(150, 8)).astype(np.float32)
+    model = GAT(hidden=6, num_classes=3, num_layers=2, heads=2)
+    sampler = GraphSageSampler(topo, [2, 2], seed=0)
+    out = sampler.sample(np.arange(16))
+    n_id = np.asarray(out.n_id)
+    x = jnp.asarray(
+        np.where((n_id >= 0)[:, None], x_all[np.maximum(n_id, 0)], 0)
+    )
+    params = init_model(model, jax.random.PRNGKey(3), x, out.adjs)
+    hbm = np.asarray(gat_layerwise_inference(model, params, topo, x_all,
+                                             chunk=131))
+    host = np.asarray(gat_layerwise_inference(model, params, topo, x_all,
+                                              chunk=131, mode="HOST"))
+    np.testing.assert_allclose(host, hbm, rtol=1e-6)
+
+
+def _rgcn_oracle(num_bases):
+    from quiver_tpu import HeteroCSRTopo, HeteroGraphSampler
+    from quiver_tpu.models.inference import rgcn_layerwise_inference
+    from quiver_tpu.models.rgcn import RGCN
+
+    rng = np.random.default_rng(12)
+    n_paper, n_author = 120, 50
+    topo = HeteroCSRTopo(
+        {"paper": n_paper, "author": n_author},
+        {
+            ("paper", "cites", "paper"): np.stack([
+                rng.integers(0, n_paper, 300),
+                rng.integers(0, n_paper, 300),
+            ]),
+            ("author", "writes", "paper"): np.stack([
+                rng.integers(0, n_author, 200),
+                rng.integers(0, n_paper, 200),
+            ]),
+            ("paper", "by", "author"): np.stack([
+                rng.integers(0, n_paper, 150),
+                rng.integers(0, n_author, 150),
+            ]),
+        },
+    )
+    x_full = {
+        "paper": rng.normal(size=(n_paper, 9)).astype(np.float32),
+        "author": rng.normal(size=(n_author, 7)).astype(np.float32),
+    }
+    model = RGCN(hidden=12, num_classes=4, target_type="paper",
+                 num_layers=2, num_bases=num_bases)
+
+    sampler = HeteroGraphSampler(topo, [-1, -1], input_type="paper", seed=0)
+    seeds = np.arange(32)
+    out = sampler.sample(seeds)
+    assert int(out.overflow) == 0
+    x_dict = {
+        t: jnp.asarray(np.where(
+            (np.asarray(ids) >= 0)[:, None],
+            x_full[t][np.maximum(np.asarray(ids), 0)], 0,
+        ))
+        for t, ids in out.n_id.items()
+    }
+    params = model.init(
+        {"params": jax.random.PRNGKey(4)}, x_dict, out.adjs
+    )["params"]
+    sampled = np.asarray(
+        model.apply({"params": params}, x_dict, out.adjs, train=False)
+    )[: len(seeds)]
+
+    full = np.asarray(
+        rgcn_layerwise_inference(model, params, topo, x_full, chunk=67)
+    )[seeds]
+    np.testing.assert_allclose(sampled, full, rtol=2e-4, atol=2e-5)
+
+
+def test_rgcn_layerwise_matches_full_fanout_sampled_model():
+    """R-GCN analogue of the SAGE/GAT oracles, full per-relation weights."""
+    _rgcn_oracle(num_bases=0)
+
+
+def test_rgcn_layerwise_matches_with_basis_decomposition():
+    _rgcn_oracle(num_bases=3)
+
+
 def test_layerwise_inference_matches_full_fanout_sampled_model():
     """End-to-end oracle: with fanout -1 (every neighbor taken) the sampled
     model's seed predictions equal the whole-graph layer-wise pass."""
