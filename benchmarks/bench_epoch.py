@@ -55,6 +55,12 @@ def main():
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--train-nodes", type=int, default=PRODUCTS_TRAIN_NODES)
     p.add_argument(
+        "--bf16", action="store_true",
+        help="bfloat16 feature storage + mixed-precision model compute "
+        "(f32 params, bf16 MXU matmuls) — the TPU-first precision recipe "
+        "the fp32-only reference has no analogue of",
+    )
+    p.add_argument(
         "--prefetch", type=int, default=2,
         help="batches in flight beyond the current one (Prefetcher depth) — "
         "the analogue of the reference's DataLoader worker prefetching; "
@@ -79,7 +85,10 @@ def _body(args):
     feat = np.random.default_rng(args.seed).normal(size=(n, args.feature_dim))
     feat = feat.astype(np.float32)
     budget = int(args.cache_ratio * n) * args.feature_dim * 4
-    feature = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(feat)
+    feature = Feature(
+        device_cache_size=budget, csr_topo=topo,
+        dtype="bfloat16" if args.bf16 else None,
+    ).from_cpu_tensor(feat)
     del feat
     # auto caps right-size every frontier to observed uniques — without this
     # the deepest n_id is worst-case-padded and the feature gather + model
@@ -92,15 +101,17 @@ def _body(args):
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)
     )
 
+    dtype = "bfloat16" if args.bf16 else None
     if args.model == "gat":
         from quiver_tpu.models.gat import GAT
 
         model = GAT(hidden=args.hidden, num_classes=args.classes,
-                    num_layers=len(args.fanout), heads=args.heads)
+                    num_layers=len(args.fanout), heads=args.heads,
+                    dtype=dtype)
     else:
         model = GraphSAGE(
             hidden=args.hidden, num_classes=args.classes,
-            num_layers=len(args.fanout)
+            num_layers=len(args.fanout), dtype=dtype,
         )
     tx = optax.adam(1e-3)
     step = jax.jit(make_train_step(model, tx))
@@ -183,6 +194,7 @@ def _body(args):
         model=args.model,
         mode=args.mode,
         prefetch=args.prefetch,
+        precision="bf16" if args.bf16 else "f32",
         final_loss=round(float(loss), 4),
     )
 

@@ -36,6 +36,11 @@ def main():
         choices=["auto", "pallas", "xla"],
         help="hot-tier gather kernel (auto = pallas on TPU, xla elsewhere)",
     )
+    p.add_argument(
+        "--dtype", default="f32", choices=["f32", "bf16", "int8"],
+        help="feature storage dtype: bf16 halves row bytes; int8 "
+        "(per-row absmax quantization, dequant on gather) quarters them",
+    )
     p.set_defaults(iters=50, warmup=5)
     args = p.parse_args()
     run_guarded(lambda: _body(args), args)
@@ -53,9 +58,11 @@ def _body(args):
     feat = np.random.default_rng(args.seed).normal(size=(n, f)).astype(np.float32)
     budget = int(args.cache_ratio * n) * f * 4
 
+    dtype = {"f32": None, "bf16": "bfloat16", "int8": "int8"}[args.dtype]
     if args.policy == "replicate":
         store = Feature(
-            device_cache_size=budget, csr_topo=topo, kernel=args.kernel
+            device_cache_size=budget, csr_topo=topo, kernel=args.kernel,
+            dtype=dtype,
         ).from_cpu_tensor(feat)
     else:
         mesh = make_mesh(feature=len(jax.devices()))
@@ -64,6 +71,7 @@ def _body(args):
             device_cache_size=budget // len(jax.devices()),
             csr_topo=topo,
             kernel=args.kernel,
+            dtype=dtype,
         ).from_cpu_tensor(feat)
     del feat
 
@@ -82,11 +90,18 @@ def _body(args):
     jax.block_until_ready(res)
     log(f"warmup+compile: {time.time()-t0:.1f}s; hot ratio {store.cache_ratio:.2f}")
 
+    # count bytes PHYSICALLY moved by the gather: the stored dtype's row
+    # bytes (+ the 4-byte dequant scale per row for int8) — int8's output
+    # is dequantized f32, and counting that would inflate GB/s 4x
+    stored_itemsize = np.dtype(store.dtype).itemsize
+    row_overhead = 4 if args.dtype == "int8" else 0
     total_bytes = 0
     t0 = time.time()
     for i in range(args.iters):
         res = store[jnp.asarray(batches[i % len(batches)])]
-        total_bytes += res.size * res.dtype.itemsize
+        total_bytes += res.shape[0] * (
+            res.shape[1] * stored_itemsize + row_overhead
+        )
     jax.block_until_ready(res)
     dt = time.time() - t0
 
@@ -97,7 +112,8 @@ def _body(args):
         BASELINE_GBPS,
         policy=args.policy,
         kernel=store.kernel,
-        cache_ratio=args.cache_ratio,
+        dtype=args.dtype,
+        cache_ratio=round(store.cache_ratio, 3),
         gather_batch=args.gather_batch,
     )
 

@@ -44,6 +44,14 @@ JOBS = [
      "XLA-gather control for the kernel=auto row"),
     ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
      "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
+    ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
+     "mixed-precision (bf16 MXU matmuls + bf16 feature rows) vs the f32 row"),
+    ("feature-bf16", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--dtype", "bf16"],
+     "bf16 rows: 2x rows/s at equal GB/s, 2x cache rows per budget"),
+    ("feature-int8", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--dtype", "int8"],
+     "int8 quantized rows (absmax/row): ~4x cache rows per budget"),
     ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"],
      "beyond-HBM topology placement"),
     ("rgcn", "benchmarks.bench_rgcn", [],

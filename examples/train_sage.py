@@ -110,6 +110,10 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.01)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
+        "--bf16", action="store_true",
+        help="bfloat16 feature storage + mixed-precision model compute",
+    )
+    p.add_argument(
         "--eval", default="sampled", choices=["sampled", "layerwise"],
         help="test-time evaluation: batched sampled fanout (fast) or "
         "full-neighbor layer-wise inference over all edges (the "
@@ -128,9 +132,10 @@ def main(argv=None):
 
     # quiver.Feature equivalent: degree-ordered 20% HBM cache, cold rows on host
     budget = int(args.cache_ratio * n) * ds.feature_dim * 4
-    feature = Feature(device_cache_size=budget, csr_topo=topo).from_cpu_tensor(
-        ds.features
-    )
+    feature = Feature(
+        device_cache_size=budget, csr_topo=topo,
+        dtype="bfloat16" if args.bf16 else None,
+    ).from_cpu_tensor(ds.features)
     # drop the source array: the tiered store holds the only copy now
     # (for Reddit/products scale this halves peak host memory)
     ds = ds._replace(features=None)
@@ -140,7 +145,8 @@ def main(argv=None):
     sampler = GraphSageSampler(topo, args.fanout, seed_capacity=args.batch,
                                seed=args.seed, frontier_caps="auto")
     model = GraphSAGE(hidden=args.hidden, num_classes=ds.num_classes,
-                      num_layers=len(args.fanout))
+                      num_layers=len(args.fanout),
+                      dtype="bfloat16" if args.bf16 else None)
     tx = optax.adam(args.lr)
     train_step = jax.jit(make_train_step(model, tx))
     eval_step = jax.jit(make_eval_step(model))

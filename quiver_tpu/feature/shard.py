@@ -32,6 +32,7 @@ from ..core.config import CachePolicy, parse_size_bytes
 from .feature import (
     KernelChoice,
     _hot_gather_fn,
+    _parse_storage_dtype,
     tiered_lookup,
     validate_gather_kernel,
 )
@@ -160,10 +161,20 @@ class ShardedFeature(KernelChoice):
         axis: str = FEATURE_AXIS,
         hot_shuffle_seed: int = 0,
         kernel: str = "auto",
+        dtype=None,
     ):
         self.mesh = mesh
         self.axis = axis
         self._kernel = validate_gather_kernel(kernel)
+        self.storage_dtype = _parse_storage_dtype(dtype)
+        if self.storage_dtype == np.dtype(np.int8):
+            # a plain astype would truncate floats to garbage; the
+            # quantized (scaled) int8 path lives in Feature only for now
+            raise NotImplementedError(
+                "int8 quantized storage is supported on Feature "
+                "(device_replicate); use dtype='bfloat16' for the sharded "
+                "store"
+            )
         self.cache_policy = CachePolicy.MESH_SHARD
         self.cache_budget = parse_size_bytes(device_cache_size)
         self.csr_topo = csr_topo
@@ -177,6 +188,8 @@ class ShardedFeature(KernelChoice):
 
     def from_cpu_tensor(self, tensor: np.ndarray) -> "ShardedFeature":
         tensor = np.asarray(tensor)
+        if self.storage_dtype is not None and tensor.dtype != self.storage_dtype:
+            tensor = tensor.astype(self.storage_dtype)
         n, f = tensor.shape
         row_bytes = f * tensor.dtype.itemsize
         num_shards = self.mesh.shape[self.axis]

@@ -40,6 +40,7 @@ class GATConv(nn.Module):
     heads: int = 1
     concat: bool = True
     negative_slope: float = 0.2
+    dtype: str | None = None  # "bfloat16" = mixed-precision compute
 
     def setup(self):
         # setup-style (attribute/param names keep the original compact
@@ -47,7 +48,8 @@ class GATConv(nn.Module):
         # inference (models/inference.py) can reuse trained weights through
         # the project/finish methods
         H, F = self.heads, self.features
-        self.lin = nn.Dense(H * F, use_bias=False, name="lin")
+        self.lin = nn.Dense(H * F, use_bias=False, dtype=self.dtype,
+                            name="lin")
         self.att_l = self.param(
             "att_l", nn.initializers.glorot_uniform(), (H, F)
         )
@@ -110,6 +112,7 @@ class GAT(nn.Module):
     num_layers: int = 2
     heads: int = 4
     dropout: float = 0.5
+    dtype: str | None = None  # "bfloat16" = mixed-precision compute
 
     @nn.compact
     def __call__(self, x, adjs: Sequence, *, train: bool = False):
@@ -118,6 +121,8 @@ class GAT(nn.Module):
                 f"model has {self.num_layers} layers but got {len(adjs)} adjs; "
                 "sampler sizes and num_layers must match"
             )
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         for i, adj in enumerate(adjs):
             num_dst = adj.size[1]
             last = i == self.num_layers - 1
@@ -125,9 +130,11 @@ class GAT(nn.Module):
                 features=self.num_classes if last else self.hidden,
                 heads=1 if last else self.heads,
                 concat=not last,
+                dtype=self.dtype,
                 name=f"conv{i}",
             )(x, adj.edge_index, num_dst)
             if not last:
                 x = nn.elu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.log_softmax(x, axis=-1)
+        # log-softmax in f32: bf16 has too little mantissa for stable NLL
+        return nn.log_softmax(x.astype(jnp.float32), axis=-1)

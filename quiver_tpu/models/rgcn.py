@@ -36,16 +36,19 @@ def _rel_name(et) -> str:
 class RGCNLayer(nn.Module):
     features: int
     num_bases: int = 0  # 0 = full per-relation weights
+    dtype: str | None = None  # "bfloat16" = mixed-precision compute
 
     @nn.compact
     def __call__(self, x_dict: dict, layer) -> dict:
         """x_dict: {type: (src_cap_t, F)}; layer: HeteroLayer."""
+        if self.dtype is not None:
+            x_dict = {t: v.astype(self.dtype) for t, v in x_dict.items()}
         out = {}
         for t, cap in layer.dst_caps.items():
             if t in x_dict:
-                out[t] = nn.Dense(self.features, name=f"self_{t}")(
-                    x_dict[t][:cap]
-                )
+                out[t] = nn.Dense(
+                    self.features, dtype=self.dtype, name=f"self_{t}"
+                )(x_dict[t][:cap])
 
         rel_keys = sorted(layer.adjs, key=str)
         # one basis set per distinct source feature width (node types may
@@ -68,10 +71,16 @@ class RGCNLayer(nn.Module):
                     (self.num_bases,),
                 )
                 w = jnp.einsum("b,bif->if", coef, bases_by_dim[in_dim])
+                if self.dtype is not None:
+                    # the basis combination stays f32 (params), but the big
+                    # per-relation matmul must hit the MXU in bf16 like the
+                    # Dense branch does
+                    w = w.astype(self.dtype)
                 h = x_dict[s_t] @ w
             else:
                 h = nn.Dense(
-                    self.features, use_bias=False, name=f"rel_{_rel_name(et)}"
+                    self.features, use_bias=False, dtype=self.dtype,
+                    name=f"rel_{_rel_name(et)}",
                 )(x_dict[s_t])
             src, dst = adj.edge_index
             msgs, valid = gather_src(h, src)
@@ -96,6 +105,7 @@ class RGCN(nn.Module):
     num_layers: int = 2
     num_bases: int = 0
     dropout: float = 0.5
+    dtype: str | None = None  # "bfloat16" = mixed-precision compute
 
     @nn.compact
     def __call__(self, x_dict: dict, layers: Sequence, *, train: bool = False):
@@ -109,10 +119,14 @@ class RGCN(nn.Module):
                 self.num_classes if i == self.num_layers - 1 else self.hidden
             )
             x_dict = RGCNLayer(
-                feats, num_bases=self.num_bases, name=f"conv{i}"
+                feats, num_bases=self.num_bases, dtype=self.dtype,
+                name=f"conv{i}",
             )(x_dict, layer)
             if i != self.num_layers - 1:
                 x_dict = {t: nn.relu(v) for t, v in x_dict.items()}
                 drop = nn.Dropout(self.dropout, deterministic=not train)
                 x_dict = {t: drop(v) for t, v in x_dict.items()}
-        return nn.log_softmax(x_dict[self.target_type], axis=-1)
+        # log-softmax in f32: bf16 has too little mantissa for stable NLL
+        return nn.log_softmax(
+            x_dict[self.target_type].astype(jnp.float32), axis=-1
+        )

@@ -22,12 +22,18 @@ __all__ = ["SAGEConv", "GraphSAGE"]
 
 class SAGEConv(nn.Module):
     features: int
+    # computation dtype (None = float32): "bfloat16" runs the matmuls and
+    # aggregation in bf16 on the MXU while params stay float32 — the
+    # standard TPU mixed-precision recipe. The reference is fp32-only.
+    dtype: str | None = None
 
     def setup(self):
         # attribute names keep the original compact-module param tree
         # ("lin_l"/"lin_r"), so existing checkpoints/params stay valid
-        self.lin_l = nn.Dense(self.features, name="lin_l")
-        self.lin_r = nn.Dense(self.features, use_bias=False, name="lin_r")
+        self.lin_l = nn.Dense(self.features, dtype=self.dtype, name="lin_l")
+        self.lin_r = nn.Dense(
+            self.features, use_bias=False, dtype=self.dtype, name="lin_r"
+        )
 
     def combine(self, agg, x_self):
         """W_l · aggregated-neighbors + W_r · x_self — exposed separately so
@@ -49,6 +55,7 @@ class GraphSAGE(nn.Module):
     num_classes: int
     num_layers: int = 2
     dropout: float = 0.5
+    dtype: str | None = None  # "bfloat16" = mixed-precision compute
 
     @nn.compact
     def __call__(self, x, adjs: Sequence, *, train: bool = False):
@@ -57,11 +64,16 @@ class GraphSAGE(nn.Module):
                 f"model has {self.num_layers} layers but got {len(adjs)} adjs; "
                 "sampler sizes and num_layers must match"
             )
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         for i, adj in enumerate(adjs):
             num_dst = adj.size[1]
             feats = self.num_classes if i == self.num_layers - 1 else self.hidden
-            x = SAGEConv(feats, name=f"conv{i}")(x, adj.edge_index, num_dst)
+            x = SAGEConv(feats, dtype=self.dtype, name=f"conv{i}")(
+                x, adj.edge_index, num_dst
+            )
             if i != self.num_layers - 1:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.log_softmax(x, axis=-1)
+        # log-softmax in f32: bf16 has too little mantissa for stable NLL
+        return nn.log_softmax(x.astype(jnp.float32), axis=-1)
