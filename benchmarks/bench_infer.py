@@ -1,0 +1,89 @@
+"""Full-graph layer-wise inference throughput benchmark.
+
+Measures the whole-graph evaluation path (models/inference.py — the
+reference's ``model.inference``, examples/pyg/reddit_quiver.py:68-92): a
+complete 2-layer GraphSAGE pass over EVERY node using ALL edges, as chunked
+segment aggregation. Metric: nodes/s of finished final-layer embeddings
+(= N / wall for the full multi-layer pass); extras carry the per-pass edge
+throughput. No reference number exists (it never benchmarked inference);
+this row tracks the framework's own capability.
+"""
+
+import time
+
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--feature-dim", type=int, default=100)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--classes", type=int, default=47)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--chunk", type=int, default=1 << 21)
+    p.add_argument("--mode", default="HBM", choices=["HBM", "HOST"])
+    p.set_defaults(iters=3, warmup=1)
+    args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
+
+
+def _body(args):
+    import numpy as np
+
+    import jax
+
+    from quiver_tpu.models.inference import sage_layerwise_inference
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.train import init_model
+
+    topo = build_graph(args)
+    n = topo.node_count
+    x_all = np.random.default_rng(args.seed).normal(
+        size=(n, args.feature_dim)
+    ).astype(np.float32)
+    model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
+                      num_layers=args.layers)
+
+    # params via a tiny sampled batch (inference reuses conv{i} weights)
+    from quiver_tpu import GraphSageSampler
+
+    sampler = GraphSageSampler(topo, [5] * args.layers, seed=args.seed,
+                               frontier_caps="auto")
+    out = sampler.sample(np.arange(min(128, n)))
+    import jax.numpy as jnp
+
+    n_id = np.asarray(out.n_id)
+    x0 = jnp.asarray(
+        np.where((n_id >= 0)[:, None], x_all[np.maximum(n_id, 0)], 0)
+    )
+    params = init_model(model, jax.random.PRNGKey(0), x0, out.adjs)
+
+    t0 = time.time()
+    for _ in range(max(args.warmup, 1)):  # >= 1: the first pass compiles
+        logp = sage_layerwise_inference(model, params, topo, x_all,
+                                        chunk=args.chunk, mode=args.mode)
+    jax.block_until_ready(logp)
+    log(f"warmup+compile: {time.time() - t0:.1f}s")
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        logp = sage_layerwise_inference(model, params, topo, x_all,
+                                        chunk=args.chunk, mode=args.mode)
+    jax.block_until_ready(logp)
+    dt = time.time() - t0
+
+    per_pass = dt / args.iters
+    emit(
+        "layerwise-inference-nodes/sec",
+        n / per_pass,
+        "nodes/s",
+        None,
+        mode=args.mode,
+        layers=args.layers,
+        pass_seconds=round(per_pass, 3),
+        edges_per_sec=round(args.layers * topo.edge_count / per_pass, 1),
+    )
+
+
+if __name__ == "__main__":
+    main()

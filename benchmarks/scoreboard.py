@@ -56,6 +56,8 @@ JOBS = [
      "beyond-HBM topology placement"),
     ("rgcn", "benchmarks.bench_rgcn", [],
      "no reference baseline (hetero is beyond-parity)"),
+    ("infer-layerwise", "benchmarks.bench_infer", [],
+     "full-graph layer-wise inference (reference never benchmarked it)"),
     ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"],
      "no reference baseline (SAINT never landed there)"),
     ("validation", "benchmarks.tpu_validation", [],

@@ -114,6 +114,12 @@ def main(argv=None):
         help="bfloat16 feature storage + mixed-precision model compute",
     )
     p.add_argument(
+        "--save-dir", default=None,
+        help="checkpoint directory (orbax Checkpointer): training resumes "
+        "from the latest checkpoint there and saves each epoch — the "
+        "checkpoint/resume capability the reference has none of",
+    )
+    p.add_argument(
         "--eval", default="sampled", choices=["sampled", "layerwise"],
         help="test-time evaluation: batched sampled fanout (fast) or "
         "full-neighbor layer-wise inference over all edges (the "
@@ -157,8 +163,23 @@ def main(argv=None):
         "params"]
     opt_state = tx.init(params)
 
+    ckpt = start_epoch = None
+    if args.save_dir:
+        from quiver_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.save_dir)
+        start_epoch = ckpt.latest_step()
+        if start_epoch is not None:
+            state = ckpt.restore(template={
+                "params": params, "opt_state": opt_state,
+            })
+            params, opt_state = state["params"], state["opt_state"]
+            print(f"resumed from {args.save_dir} at epoch {start_epoch}")
+
     step_i = 0
     for epoch in range(1, args.epochs + 1):
+        if start_epoch is not None and epoch <= start_epoch:
+            continue  # already trained in a previous run
         t0 = time.time()
         order = np.random.default_rng(epoch).permutation(train_idx)
         losses, correct, total = [], 0, 0
@@ -182,6 +203,11 @@ def main(argv=None):
             f"Approx. Train Acc: {correct / max(total, 1):.4f} "
             f"({time.time() - t0:.1f}s)"
         )
+        if ckpt is not None:
+            ckpt.save(epoch, {"params": params, "opt_state": opt_state})
+
+    if ckpt is not None:
+        ckpt.wait_until_finished()
 
     if args.eval == "layerwise":
         test_acc = evaluate_layerwise(

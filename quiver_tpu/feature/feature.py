@@ -44,14 +44,24 @@ __all__ = ["Feature", "HeteroFeature", "tiered_lookup", "resolve_gather_kernel"]
 def _parse_storage_dtype(dtype):
     """None (keep input dtype) or a numpy dtype; "bf16"/"bfloat16" resolve
     through ml_dtypes (numpy has no native bfloat16; ml_dtypes ships with
-    jax). int8 means per-row absmax quantization (scales kept alongside)."""
+    jax). int8 means per-row absmax quantization (scales kept alongside);
+    other integer dtypes are rejected — a plain astype would truncate float
+    features to garbage silently."""
     if dtype is None:
         return None
     if str(dtype) in ("bf16", "bfloat16"):
         from ml_dtypes import bfloat16
 
         return np.dtype(bfloat16)
-    return np.dtype(dtype)
+    dt = np.dtype(dtype)
+    if dt == np.dtype(np.int8):
+        return dt
+    if dt.kind != "f":
+        raise ValueError(
+            f"storage dtype must be a float dtype, 'bfloat16', or 'int8' "
+            f"(quantized); got {dtype!r}"
+        )
+    return dt
 
 
 def quantize_rows_int8(tensor: np.ndarray):

@@ -91,7 +91,11 @@ class GATConv(nn.Module):
         logits = alpha_src[src_safe] + alpha_dst[jnp.clip(dst, 0, num_dst - 1)]
         logits = nn.leaky_relu(logits, self.negative_slope)  # (E, H)
         # segment softmax over each destination's edges, all heads at once
+        # (computed in f32 via the att-param promotion for stability, then
+        # downcast so the big (E, H, F) message/scatter traffic runs at the
+        # compute dtype rather than silently promoting back to f32)
         alpha = segment_softmax(logits, dst_safe, valid, num_dst)  # (E, H)
+        alpha = alpha.astype(h_all.dtype)
 
         msgs = h_all[src_safe] * alpha[:, :, None]  # (E, H, F)
         msgs = jnp.where(valid[:, None, None], msgs, 0.0)
