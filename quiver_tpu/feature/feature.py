@@ -86,6 +86,24 @@ def _dequant_fn(gather, scale_for):
     return lambda ids: gather(ids).astype(jnp.float32) * scale_for(ids)[:, None]
 
 
+def wrap_dequant_gathers(scale, hot_rows: int, hot_gather, cold_gather):
+    """Shared int8-dequant wrapping for both feature stores' tiered gathers.
+
+    Scale ids live in the translated (reordered) global row space: hot
+    gathers receive those directly, cold gathers receive ids offset by
+    ``hot_rows``. No-op when ``scale`` is None (unquantized storage).
+    """
+    if scale is None:
+        return hot_gather, cold_gather
+    if hot_gather is not None:
+        hot_gather = _dequant_fn(hot_gather, lambda ids: scale[ids])
+    if cold_gather is not None:
+        cold_gather = _dequant_fn(
+            cold_gather, lambda ids: scale[ids + hot_rows]
+        )
+    return hot_gather, cold_gather
+
+
 def validate_gather_kernel(kernel: str) -> str:
     """Eager argument check only — MUST NOT touch the JAX backend (object
     construction must stay cheap and never initialize/lock backend choice)."""
@@ -330,17 +348,9 @@ class Feature(KernelChoice):
             if self.cold is None
             else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
         )
-        if self.scale is not None:
-            # int8 tiers dequantize on device right after the gather; scale
-            # ids are in the translated (reordered) global row space — hot
-            # gathers receive those directly, cold gathers the offset ids
-            if hot_gather is not None:
-                hot_gather = _dequant_fn(hot_gather, lambda ids: self.scale[ids])
-            if cold_gather is not None:
-                hr = self.hot_rows
-                cold_gather = _dequant_fn(
-                    cold_gather, lambda ids: self.scale[ids + hr]
-                )
+        hot_gather, cold_gather = wrap_dequant_gathers(
+            self.scale, self.hot_rows, hot_gather, cold_gather
+        )
         with trace_scope("feature_gather"):
             return tiered_lookup(
                 n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather

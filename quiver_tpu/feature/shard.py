@@ -33,8 +33,10 @@ from .feature import (
     KernelChoice,
     _hot_gather_fn,
     _parse_storage_dtype,
+    quantize_rows_int8,
     tiered_lookup,
     validate_gather_kernel,
+    wrap_dequant_gathers,
 )
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
@@ -167,14 +169,6 @@ class ShardedFeature(KernelChoice):
         self.axis = axis
         self._kernel = validate_gather_kernel(kernel)
         self.storage_dtype = _parse_storage_dtype(dtype)
-        if self.storage_dtype == np.dtype(np.int8):
-            # a plain astype would truncate floats to garbage; the
-            # quantized (scaled) int8 path lives in Feature only for now
-            raise NotImplementedError(
-                "int8 quantized storage is supported on Feature "
-                "(device_replicate); use dtype='bfloat16' for the sharded "
-                "store"
-            )
         self.cache_policy = CachePolicy.MESH_SHARD
         self.cache_budget = parse_size_bytes(device_cache_size)
         self.csr_topo = csr_topo
@@ -183,17 +177,33 @@ class ShardedFeature(KernelChoice):
         self.cold = None
         self._cold_is_host = False
         self.feature_order = None
+        self.scale = None  # (N,) dequant scales (int8 storage only)
         self.hot_rows = 0
         self.shape = None
 
     def from_cpu_tensor(self, tensor: np.ndarray) -> "ShardedFeature":
         tensor = np.asarray(tensor)
-        if self.storage_dtype is not None and tensor.dtype != self.storage_dtype:
+        quantized = (
+            self.storage_dtype is not None
+            and self.storage_dtype == np.dtype(np.int8)
+        )
+        if (
+            self.storage_dtype is not None
+            and not quantized
+            and tensor.dtype != self.storage_dtype
+        ):
             tensor = tensor.astype(self.storage_dtype)
         n, f = tensor.shape
-        row_bytes = f * tensor.dtype.itemsize
         num_shards = self.mesh.shape[self.axis]
-        hot_rows = min(n, (self.cache_budget // row_bytes) * num_shards)
+        if quantized:
+            # the (N,) f32 scale array is replicated on EVERY device (both
+            # tiers dequantize on device) — charge its 4N bytes against the
+            # per-device budget before spending on 1-byte-element hot rows
+            per_dev_rows = max(self.cache_budget - 4 * n, 0) // f
+            hot_rows = min(n, per_dev_rows * num_shards)
+        else:
+            row_bytes = f * tensor.dtype.itemsize
+            hot_rows = min(n, (self.cache_budget // row_bytes) * num_shards)
 
         if self.csr_topo is not None and 0 < hot_rows < n:
             tensor, order = reorder_by_degree(
@@ -204,6 +214,10 @@ class ShardedFeature(KernelChoice):
             )
             self.csr_topo.feature_order = order
             self.feature_order = jnp.asarray(order)
+
+        if quantized:
+            tensor, scale = quantize_rows_int8(tensor)  # AFTER the reorder
+            self.scale = jnp.asarray(scale)
 
         self.shape = (n, f)
         self.dtype = tensor.dtype
@@ -225,7 +239,7 @@ class ShardedFeature(KernelChoice):
             n,
             num_shards,
             self.axis,
-            hot_rows * row_bytes / num_shards / 2**20,
+            hot_rows * f * tensor.dtype.itemsize / num_shards / 2**20,
             "pinned host" if self._cold_is_host else ("none" if hot_rows == n else "device"),
         )
         return self
@@ -238,10 +252,10 @@ class ShardedFeature(KernelChoice):
         """Free hot/cold buffers now (reference ``shard_tensor.delete``)."""
         if self.hot is not None:
             self.hot.delete()
-        for buf in (self.cold, self.feature_order):
+        for buf in (self.cold, self.feature_order, self.scale):
             if buf is not None and hasattr(buf, "delete"):
                 buf.delete()
-        self.hot = self.cold = self.feature_order = None
+        self.hot = self.cold = self.feature_order = self.scale = None
         self.hot_rows = 0
 
     def __getitem__(self, n_id):
@@ -253,6 +267,11 @@ class ShardedFeature(KernelChoice):
             else lambda ids: staged_gather(
                 self.cold, ids, self._cold_is_host, mesh=self.mesh
             )
+        )
+        # int8 tiers dequantize after the (psum'd) gather; only one shard
+        # contributes non-zero int8 rows so the reduction is overflow-free
+        hot_gather, cold_gather = wrap_dequant_gathers(
+            self.scale, self.hot_rows, hot_gather, cold_gather
         )
         return tiered_lookup(
             n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather

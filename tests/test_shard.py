@@ -73,6 +73,40 @@ def test_sharded_feature_mixed_tiers():
     assert np.allclose(out, t[ids])
 
 
+def test_sharded_feature_int8_quantized():
+    """int8 over the mesh: psum'd int8 gather + on-device dequant must land
+    within the per-row quantization bound; budget charges the replicated
+    scale array first."""
+    mesh = _mesh()
+    n, f = 400, 8
+    t = np.random.default_rng(8).normal(size=(n, f)).astype(np.float32)
+    budget = 4 * n + 30 * f  # scale bytes + 30 int8 rows per device
+    feat = ShardedFeature(
+        mesh, device_cache_size=budget, dtype="int8"
+    ).from_cpu_tensor(t)
+    assert feat.hot_rows == 60  # 30 rows x 2 feature shards
+    assert feat.cold is not None
+    ids = np.concatenate(
+        [np.random.default_rng(9).integers(0, n, 80), [-1, -1]]
+    )
+    out = np.asarray(feat[jnp.asarray(ids)])
+    assert out.dtype == np.float32
+    bound = (np.abs(t).max(axis=1) / 254.0 + 1e-7)[ids[:80]][:, None]
+    assert np.all(np.abs(out[:80] - t[ids[:80]]) <= bound)
+    assert np.all(out[80:] == 0)
+
+
+def test_sharded_feature_bf16():
+    mesh = _mesh()
+    t = np.random.default_rng(10).normal(size=(300, 8)).astype(np.float32)
+    feat = ShardedFeature(
+        mesh, device_cache_size="1G", dtype="bf16"
+    ).from_cpu_tensor(t)
+    ids = np.random.default_rng(11).integers(0, 300, 64)
+    out = np.asarray(feat[jnp.asarray(ids)], dtype=np.float32)
+    np.testing.assert_allclose(out, t[ids], rtol=1e-2, atol=1e-2)
+
+
 def test_sharded_feature_reorder_and_invalid():
     ei = generate_pareto_graph(300, 6.0, seed=8)
     topo = CSRTopo(edge_index=ei)
