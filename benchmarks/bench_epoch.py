@@ -33,6 +33,7 @@ from benchmarks.common import (
     emit,
     log,
     run_guarded,
+    trimmed_mean,
 )
 
 BASELINE_EPOCH_S = 11.1
@@ -54,6 +55,15 @@ def main():
     )
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--train-nodes", type=int, default=PRODUCTS_TRAIN_NODES)
+    p.add_argument(
+        "--fused", action="store_true",
+        help="ONE XLA program per step (DistributedTrainer on the device "
+        "mesh): sample + gather + fwd/bwd + update with zero host "
+        "round-trips. Requires the feature table fully HBM-resident, so "
+        "this forces cache-ratio 1.0 — compare against the reference's "
+        "'PyG with full feature on GPU' rows (Introduction_en.md:153-158) "
+        "as well as its headline",
+    )
     p.add_argument(
         "--bf16", action="store_true",
         help="bfloat16 feature storage + mixed-precision model compute "
@@ -84,6 +94,10 @@ def _body(args):
     n = topo.node_count
     feat = np.random.default_rng(args.seed).normal(size=(n, args.feature_dim))
     feat = feat.astype(np.float32)
+    if args.fused and args.cache_ratio < 1.0:
+        log("fused mode requires a fully HBM-resident table; "
+            "forcing cache-ratio 1.0")
+        args.cache_ratio = 1.0
     budget = int(args.cache_ratio * n) * args.feature_dim * 4
     feature = Feature(
         device_cache_size=budget, csr_topo=topo,
@@ -117,6 +131,12 @@ def _body(args):
     step = jax.jit(make_train_step(model, tx))
 
     rng = np.random.default_rng(args.seed + 1)
+
+    if args.fused:
+        iter_s, loss = _fused_measure(args, topo, feature, model, tx,
+                                      labels_all, rng)
+        _emit_epoch(args, iter_s, loss, fused=True)
+        return
 
     def iteration(params, opt_state, key):
         seeds = rng.integers(0, n, args.batch)
@@ -169,16 +189,59 @@ def _body(args):
             jax.block_until_ready(loss)
             times.append(time.time() - t0)
 
-        # trimmed mean: drop fastest/slowest 10% (reference drops the first
-        # epoch and averages the rest; per-iteration trimming is the same
-        # idea at iter scale)
-        times = np.sort(times)
-        k = max(1, len(times) // 10)
-        iter_s = (
-            float(np.mean(times[k:-k]))
-            if len(times) > 2 * k
-            else float(np.mean(times))
+        iter_s = trimmed_mean(times)
+    _emit_epoch(args, iter_s, loss, fused=False)
+
+
+def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
+    """DistributedTrainer path: the whole iteration is ONE compiled program
+    (sample -> gather -> fwd/bwd -> update), measured like the serial loop."""
+    import time as _time
+
+    import jax
+
+    from quiver_tpu import DistributedTrainer, GraphSageSampler
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    n = topo.node_count
+    mesh = make_mesh()
+    # ceil: shard_seeds' first blocks get ceil(batch/data) seeds
+    local_batch = -(-args.batch // mesh.shape["data"])
+    # a dedicated sampler sized to the PER-DEVICE block, with auto caps
+    # planned from a local-batch draw — planning at the global batch would
+    # leave every device running frontiers ~data-size too wide
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode="HBM", seed_capacity=local_batch,
+        seed=args.seed, frontier_caps="auto",
+    )
+    sampler.sample(rng.integers(0, n, local_batch))
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, tx, local_batch=local_batch
+    )
+    params, opt_state = trainer.init(jax.random.PRNGKey(0))
+
+    t0 = _time.time()
+    for i in range(args.warmup):
+        params, opt_state, loss = trainer.step(
+            params, opt_state, rng.integers(0, n, args.batch), labels_all,
+            jax.random.PRNGKey(i),
         )
+    jax.block_until_ready(loss)
+    log(f"fused warmup+compile: {_time.time() - t0:.1f}s")
+
+    times = []
+    for i in range(args.iters):
+        t0 = _time.time()
+        params, opt_state, loss = trainer.step(
+            params, opt_state, rng.integers(0, n, args.batch), labels_all,
+            jax.random.PRNGKey(100 + i),
+        )
+        jax.block_until_ready(loss)
+        times.append(_time.time() - t0)
+    return trimmed_mean(times), loss
+
+
+def _emit_epoch(args, iter_s, loss, fused: bool):
     iters_per_epoch = -(-args.train_nodes // args.batch)
     epoch_s = iter_s * iters_per_epoch
 
@@ -192,8 +255,8 @@ def _body(args):
         iters_per_epoch=iters_per_epoch,
         batch=args.batch,
         model=args.model,
-        mode=args.mode,
-        prefetch=args.prefetch,
+        mode="FUSED" if fused else args.mode,
+        prefetch=0 if fused else args.prefetch,  # fused never prefetches
         precision="bf16" if args.bf16 else "f32",
         final_loss=round(float(loss), 4),
     )

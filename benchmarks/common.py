@@ -355,6 +355,19 @@ def build_graph(args):
     return topo
 
 
+def trimmed_mean(times) -> float:
+    """10%-trimmed mean of iteration times (the reference drops the first
+    epoch and averages the rest; per-iteration trimming is the same idea at
+    iter scale)."""
+    import numpy as np
+
+    times = np.sort(np.asarray(times, dtype=float))
+    k = max(1, len(times) // 10)
+    if len(times) > 2 * k:
+        times = times[k:-k]
+    return float(np.mean(times))
+
+
 def log(msg: str) -> None:
     print(msg, file=sys.stderr)
 

@@ -46,6 +46,11 @@ JOBS = [
      "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
     ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
      "mixed-precision (bf16 MXU matmuls + bf16 feature rows) vs the f32 row"),
+    ("epoch-fused", "benchmarks.bench_epoch", ["--fused"],
+     "ONE XLA program per step, full-HBM table — vs ref 11.1s AND its "
+     "PyG-all-on-GPU 23.3s (Introduction_en.md:153-158)"),
+    ("epoch-fused-bf16", "benchmarks.bench_epoch", ["--fused", "--bf16"],
+     "fused + mixed precision: the framework's best-case configuration"),
     ("feature-bf16", "benchmarks.bench_feature",
      ["--policy", "replicate", "--dtype", "bf16"],
      "bf16 rows: 2x rows/s at equal GB/s, 2x cache rows per budget"),
