@@ -104,13 +104,6 @@ def _body(args):
         dtype="bfloat16" if args.bf16 else None,
     ).from_cpu_tensor(feat)
     del feat
-    # auto caps right-size every frontier to observed uniques — without this
-    # the deepest n_id is worst-case-padded and the feature gather + model
-    # aggregate run ~3x wider than needed (SURVEY §7.4.2)
-    sampler = GraphSageSampler(
-        topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
-        seed=args.seed, frontier_caps="auto",
-    )
     labels_all = jnp.asarray(
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)
     )
@@ -128,15 +121,26 @@ def _body(args):
             num_layers=len(args.fanout), dtype=dtype,
         )
     tx = optax.adam(1e-3)
-    step = jax.jit(make_train_step(model, tx))
-
     rng = np.random.default_rng(args.seed + 1)
 
     if args.fused:
+        # dispatch BEFORE constructing the serial sampler: its __init__
+        # eagerly device-places a full topology copy the fused path would
+        # never use (doubling topology HBM on top of the full-resident
+        # feature table)
         iter_s, loss = _fused_measure(args, topo, feature, model, tx,
                                       labels_all, rng)
         _emit_epoch(args, iter_s, loss, fused=True)
         return
+
+    # auto caps right-size every frontier to observed uniques — without this
+    # the deepest n_id is worst-case-padded and the feature gather + model
+    # aggregate run ~3x wider than needed (SURVEY §7.4.2)
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
+        seed=args.seed, frontier_caps="auto",
+    )
+    step = jax.jit(make_train_step(model, tx))
 
     def iteration(params, opt_state, key):
         seeds = rng.integers(0, n, args.batch)
@@ -153,7 +157,7 @@ def _body(args):
     params = model.init({"params": jax.random.PRNGKey(0)}, x0, out0.adjs)["params"]
     opt_state = tx.init(params)
     t0 = time.time()
-    for i in range(args.warmup):
+    for i in range(max(args.warmup, 1)):  # >= 1: the first call compiles
         params, opt_state, loss = iteration(params, opt_state, jax.random.PRNGKey(i))
     jax.block_until_ready(loss)
     log(f"warmup+compile: {time.time()-t0:.1f}s")
@@ -196,8 +200,6 @@ def _body(args):
 def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
     """DistributedTrainer path: the whole iteration is ONE compiled program
     (sample -> gather -> fwd/bwd -> update), measured like the serial loop."""
-    import time as _time
-
     import jax
 
     from quiver_tpu import DistributedTrainer, GraphSageSampler
@@ -220,24 +222,24 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
     )
     params, opt_state = trainer.init(jax.random.PRNGKey(0))
 
-    t0 = _time.time()
-    for i in range(args.warmup):
+    t0 = time.time()
+    for i in range(max(args.warmup, 1)):  # >= 1: the first step compiles
         params, opt_state, loss = trainer.step(
             params, opt_state, rng.integers(0, n, args.batch), labels_all,
             jax.random.PRNGKey(i),
         )
     jax.block_until_ready(loss)
-    log(f"fused warmup+compile: {_time.time() - t0:.1f}s")
+    log(f"fused warmup+compile: {time.time() - t0:.1f}s")
 
     times = []
     for i in range(args.iters):
-        t0 = _time.time()
+        t0 = time.time()
         params, opt_state, loss = trainer.step(
             params, opt_state, rng.integers(0, n, args.batch), labels_all,
             jax.random.PRNGKey(100 + i),
         )
         jax.block_until_ready(loss)
-        times.append(_time.time() - t0)
+        times.append(time.time() - t0)
     return trimmed_mean(times), loss
 
 

@@ -14,7 +14,14 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, emit, init_backend, log, run_guarded
+from benchmarks.common import (
+    base_parser,
+    emit,
+    init_backend,
+    log,
+    run_guarded,
+    trimmed_mean,
+)
 
 
 def main():
@@ -134,11 +141,7 @@ def _body(args):
         jax.block_until_ready(loss)
         times.append(time.time() - t0)
 
-    times = np.sort(times)
-    k = max(1, len(times) // 10)
-    iter_s = float(np.mean(times[k:-k])) if len(times) > 2 * k else float(
-        np.mean(times)
-    )
+    iter_s = trimmed_mean(times)
     train_nodes = n_paper // 10
     iters_per_epoch = -(-train_nodes // args.batch)
     emit(
