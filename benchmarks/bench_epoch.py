@@ -65,6 +65,14 @@ def main():
         "as well as its headline",
     )
     p.add_argument(
+        "--scan-epoch", action="store_true",
+        help="the WHOLE epoch as one compiled program (epoch_scan: lax.scan "
+        "over packed seed blocks, params in carry, one loss readback). "
+        "Measures real epoch wall time directly instead of extrapolating "
+        "iteration time — the TPU-native epoch loop. Implies --fused "
+        "placement rules (full-HBM feature table)",
+    )
+    p.add_argument(
         "--bf16", action="store_true",
         help="bfloat16 feature storage + mixed-precision model compute "
         "(f32 params, bf16 MXU matmuls) — the TPU-first precision recipe "
@@ -94,6 +102,8 @@ def _body(args):
     n = topo.node_count
     feat = np.random.default_rng(args.seed).normal(size=(n, args.feature_dim))
     feat = feat.astype(np.float32)
+    if args.scan_epoch:
+        args.fused = True
     if args.fused and args.cache_ratio < 1.0:
         log("fused mode requires a fully HBM-resident table; "
             "forcing cache-ratio 1.0")
@@ -123,6 +133,9 @@ def _body(args):
     tx = optax.adam(1e-3)
     rng = np.random.default_rng(args.seed + 1)
 
+    if args.scan_epoch:
+        _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng)
+        return
     if args.fused:
         # dispatch BEFORE constructing the serial sampler: its __init__
         # eagerly device-places a full topology copy the fused path would
@@ -241,6 +254,72 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
         jax.block_until_ready(loss)
         times.append(time.time() - t0)
     return trimmed_mean(times), loss
+
+
+def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
+                        epochs: int = 3):
+    """Measure REAL epoch wall time: the whole epoch is one compiled
+    program (DistributedTrainer.epoch_scan), so the number is a direct
+    measurement — pack + H2D of the epoch's seed matrix, the scan, and the
+    loss-vector readback all inside the clock — not an iteration-time
+    extrapolation."""
+    import jax
+
+    from quiver_tpu import DistributedTrainer, GraphSageSampler
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    n = topo.node_count
+    mesh = make_mesh()
+    local_batch = -(-args.batch // mesh.shape["data"])
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode="HBM", seed_capacity=local_batch,
+        seed=args.seed, frontier_caps="auto",
+    )
+    sampler.sample(rng.integers(0, n, local_batch))
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, tx, local_batch=local_batch
+    )
+    params, opt_state = trainer.init(jax.random.PRNGKey(0))
+    train_idx = rng.permutation(n)[: args.train_nodes]
+
+    t0 = time.time()
+    seed_mat = trainer.pack_epoch(train_idx, key=0)
+    params, opt_state, losses = trainer.epoch_scan(
+        params, opt_state, seed_mat, labels_all, jax.random.PRNGKey(1)
+    )
+    jax.block_until_ready(losses)
+    steps = int(seed_mat.shape[0])
+    log(f"scan-epoch warmup+compile: {time.time() - t0:.1f}s "
+        f"({steps} steps/epoch)")
+
+    times = []
+    for e in range(epochs):
+        t0 = time.time()
+        seed_mat = trainer.pack_epoch(train_idx, key=e + 1)
+        params, opt_state, losses = trainer.epoch_scan(
+            params, opt_state, seed_mat, labels_all,
+            jax.random.PRNGKey(2 + e),
+        )
+        final_loss = float(losses[-1])  # readback inside the clock
+        times.append(time.time() - t0)
+    epoch_s = trimmed_mean(times)
+    emit(
+        "e2e-epoch-time",
+        epoch_s,
+        "s",
+        BASELINE_EPOCH_S,
+        invert=True,
+        iter_ms=round(epoch_s / steps * 1e3, 2),
+        iters_per_epoch=steps,
+        batch=args.batch,
+        model=args.model,
+        mode="FUSED-SCAN",
+        bf16=bool(args.bf16),
+        cache_ratio=args.cache_ratio,
+        train_nodes=args.train_nodes,
+        measured="direct",
+        loss=round(final_loss, 4),
+    )
 
 
 def _emit_epoch(args, iter_s, loss, fused: bool):

@@ -322,6 +322,8 @@ def apply_smoke(args) -> None:
         args.nodes = min(args.nodes, 200_000)
         args.iters = min(args.iters, 5)
         args.warmup = min(args.warmup, 2)
+        if getattr(args, "stream", 0):
+            args.stream = min(args.stream, 4)
         if hasattr(args, "train_nodes"):
             args.train_nodes = min(args.train_nodes, 20_000)
         log(f"smoke mode: nodes={args.nodes} iters={args.iters}")

@@ -29,21 +29,26 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (key, module, args, baseline note)
 JOBS = [
-    ("sampler-hbm", "benchmarks.bench_sampler", ["--mode", "HBM", "--stages"],
+    ("sampler-hbm", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--stages", "--stream", "128"],
      "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41)"),
-    ("sampler-host", "benchmarks.bench_sampler", ["--mode", "HOST"],
+    ("sampler-host", "benchmarks.bench_sampler",
+     ["--mode", "HOST", "--stream", "128"],
      "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
     ("sampler-pallas", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--kernel", "pallas"],
+     ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
      "windowed Pallas kernel vs the XLA row above"),
     ("feature-replicate", "benchmarks.bench_feature",
-     ["--policy", "replicate"],
+     ["--policy", "replicate", "--stream", "32"],
      "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),
     ("feature-replicate-xla", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--kernel", "xla"],
+     ["--policy", "replicate", "--kernel", "xla", "--stream", "32"],
      "XLA-gather control for the kernel=auto row"),
     ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
      "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
+    ("epoch-scan", "benchmarks.bench_epoch", ["--scan-epoch", "--bf16"],
+     "whole epoch as ONE compiled program, bf16 — the TPU-native epoch "
+     "loop, measured directly (vs ref 11.1 s, Introduction_en.md:146-149)"),
     ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
      "mixed-precision (bf16 MXU matmuls + bf16 feature rows) vs the f32 row"),
     ("epoch-fused", "benchmarks.bench_epoch", ["--fused"],
@@ -222,7 +227,7 @@ def main():
             metric = rec.get("metric", "?")
             extras = {k: v for k, v in rec.items()
                       if k in ("kernel", "mode", "policy", "caps", "sampler",
-                               "layer", "stage")}
+                               "layer", "stage", "dispatch", "stream_batches")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

@@ -86,6 +86,56 @@ def test_shard_seeds_packing():
         assert np.all(b[: len(valid)] == valid)
     assert np.array_equal(np.sort(packed[packed >= 0]), np.arange(20))
 
+def test_epoch_scan_matches_step_loop():
+    """epoch_scan (whole epoch in ONE program) must reproduce the per-step
+    loop exactly: same packed blocks + same per-step keys through the same
+    _step program, so losses and final params agree."""
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [5, 5], seed=3)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat[:n])
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32
+    )
+    params0, opt0 = trainer.init(jax.random.PRNGKey(0))
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+
+    train_idx = np.random.default_rng(0).integers(0, n, 5 * trainer.global_batch)
+    seed_mat = trainer.pack_epoch(train_idx, key=7)
+    assert seed_mat.shape == (5, trainer.global_batch)
+    assert np.array_equal(
+        np.sort(seed_mat[seed_mat >= 0]), np.sort(train_idx)
+    )
+
+    key0 = jax.random.PRNGKey(42)
+    p_scan, _, losses = trainer.epoch_scan(
+        params0, opt0, seed_mat, labels_dev, key0
+    )
+    assert losses.shape == (5,)
+
+    # replay: same packed rows through the public per-step path
+    keys = jax.random.split(key0, 5)
+    p, o = params0, opt0
+    loop_losses = []
+    for s in range(5):
+        row = seed_mat[s]
+        p, o, loss = trainer.step(
+            p, o, row[row >= 0], labels_dev, keys[s]
+        )
+        loop_losses.append(float(loss))
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(loop_losses), rtol=1e-4, atol=1e-5
+    )
+    flat_scan = jax.tree_util.tree_leaves(p_scan)
+    flat_loop = jax.tree_util.tree_leaves(p)
+    for a, b in zip(flat_scan, flat_loop):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_host_offload_multichip_training_learns():
     """VERDICT r1 item 5: the beyond-HBM configuration (HOST topology +
     cold feature tier) must have a multi-chip path. DataParallelTrainer on
