@@ -33,6 +33,13 @@ def main():
         "windowed-DMA kernel (HBM mode, unweighted)",
     )
     p.add_argument(
+        "--dedup",
+        default="sort",
+        choices=["sort", "map"],
+        help="reindex dedup strategy: stable-sort run-scan or the sort-free "
+        "dense-map scatter-min (reference hash-table analogue)",
+    )
+    p.add_argument(
         "--caps",
         default="auto",
         choices=["auto", "worst"],
@@ -125,8 +132,16 @@ def _stage_profile(args, sampler, topo, reps: int = 30):
                 lambda t, c, n, kk, fan=k: sample_layer(t, c, n, fan, kk)
             )
         (nbr, counts), t_sample = timed(f_sample, sampler.topo, cur, cur_n, sub)
+        # honor the sampler's dedup strategy (same node_bound rule as
+        # multilayer_sample) so stage attribution matches the headline
+        nb_bound = (
+            int(sampler.topo.indptr.shape[0]) - 1
+            if sampler.dedup == "map" else None
+        )
         f_reindex = jax.jit(
-            lambda c, n, nb, fc=caps[l]: reindex_layer(c, n, nb, fc)
+            lambda c, n, nb, fc=caps[l]: reindex_layer(
+                c, n, nb, fc, node_bound=nb_bound
+            )
         )
         (frontier, n_frontier, _, _), t_reindex = timed(
             f_reindex, cur, cur_n, nbr
@@ -169,7 +184,7 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     import jax.numpy as jnp
     from jax import lax
 
-    cap = sampler._seed_capacity or max(args.batch, 128)
+    cap = sampler._seed_capacity  # _body always sets seed_capacity=batch
     run, _ = sampler._compiled(cap)
     rng = np.random.default_rng(args.seed + 13)
     n_vec = jnp.full((args.stream,), jnp.int32(args.batch))
@@ -212,6 +227,7 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
         fanout=args.fanout,
         batch=args.batch,
         caps=args.caps,
+        dedup=args.dedup,
         dispatch="stream",
         stream_batches=args.stream,
         overflow=int(results[-1][2]),
@@ -226,7 +242,7 @@ def _body(args):
     topo = build_graph(args)
     sampler = GraphSageSampler(
         topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
-        seed=args.seed, kernel=args.kernel,
+        seed=args.seed, kernel=args.kernel, dedup=args.dedup,
         frontier_caps="auto" if args.caps == "auto" else None,
     )
     rng = np.random.default_rng(args.seed)
@@ -268,6 +284,7 @@ def _body(args):
         fanout=args.fanout,
         batch=args.batch,
         caps=args.caps,
+        dedup=args.dedup,
         dispatch="percall",
     )
 

@@ -227,7 +227,7 @@ def main():
             metric = rec.get("metric", "?")
             extras = {k: v for k, v in rec.items()
                       if k in ("kernel", "mode", "policy", "caps", "sampler",
-                               "layer", "stage", "dispatch", "stream_batches")}
+                               "layer", "stage", "dispatch", "stream_batches", "dedup")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

@@ -51,7 +51,8 @@ def complete_permutation(p, n: int):
     return jnp.argsort(rank).astype(p.dtype)
 
 
-def masked_unique(ids, valid, size: int, num_forced: int = 0):
+def masked_unique(ids, valid, size: int, num_forced: int = 0,
+                  node_bound: int | None = None):
     """First-occurrence-order unique of ``ids[valid]``, padded to ``size``.
 
     Args:
@@ -64,6 +65,14 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0):
         duplicates included, so a batch like [7, 7, 3] must occupy three
         output slots. Later duplicates of a forced value still map to its
         first occurrence.
+      node_bound: static exclusive upper bound on valid id values. When
+        given, first occurrences are found with a scatter-min into a
+        (node_bound,)-sized position map instead of a stable sort —
+        O(node_bound + T) memset/scatter/gather vs O(T log^2 T) sort
+        passes. This is the direct analogue of the reference's GPU hash
+        table (reindex.cu.hpp:120-139 atomicMin keeps the first
+        occurrence); the dense map plays the table, scatter-min plays
+        atomicMin. Same contract either way; pick by measurement.
 
     Returns:
       uniq: (size,) unique ids in first-occurrence order, -1 padded.
@@ -73,27 +82,37 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0):
         invalid / overflowed elements.
     """
     T = ids.shape[0]
-    sent = jnp.iinfo(ids.dtype).max
-    vals = jnp.where(valid, ids, sent)
     pos = jnp.arange(T, dtype=jnp.int32)
 
-    order = jnp.argsort(vals, stable=True)
-    sv = vals[order]
-    pv = pos[order]
+    if node_bound is not None:
+        safe = jnp.where(valid, ids, 0)
+        first_pos = (
+            jnp.full((node_bound,), T, jnp.int32)
+            .at[safe]
+            .min(jnp.where(valid, pos, T), mode="drop")
+        )
+        rep_pos = first_pos[safe]
+    else:
+        sent = jnp.iinfo(ids.dtype).max
+        vals = jnp.where(valid, ids, sent)
 
-    # run starts in the sorted view (sentinel run excluded)
-    first = jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]]) & (sv != sent)
-    run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
-    # representative position (== first occurrence, because the sort is
-    # stable and positions within a run are ascending) scattered per run
-    by_run = (
-        jnp.zeros(T, jnp.int32)
-        .at[jnp.where(first, run_id, T)]
-        .set(pv, mode="drop")
-    )
-    rep_pos_sorted = by_run[jnp.clip(run_id, 0)]
-    # back to original positions
-    rep_pos = jnp.zeros(T, jnp.int32).at[order].set(rep_pos_sorted)
+        order = jnp.argsort(vals, stable=True)
+        sv = vals[order]
+        pv = pos[order]
+
+        # run starts in the sorted view (sentinel run excluded)
+        first = jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]]) & (sv != sent)
+        run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+        # representative position (== first occurrence, because the sort is
+        # stable and positions within a run are ascending) scattered per run
+        by_run = (
+            jnp.zeros(T, jnp.int32)
+            .at[jnp.where(first, run_id, T)]
+            .set(pv, mode="drop")
+        )
+        rep_pos_sorted = by_run[jnp.clip(run_id, 0)]
+        # back to original positions
+        rep_pos = jnp.zeros(T, jnp.int32).at[order].set(rep_pos_sorted)
 
     forced = (pos < num_forced) & valid
     is_rep = (valid & (rep_pos == pos)) | forced
@@ -110,7 +129,8 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0):
     return uniq, num_unique, local
 
 
-def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int):
+def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int,
+                  node_bound: int | None = None):
     """Per-layer reindex: frontier = unique(seeds ∪ neighbors), seeds first.
 
     Mirrors the reference's ``reindex_single`` contract
@@ -121,6 +141,8 @@ def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int):
       num_seeds: scalar count of valid seeds.
       neighbors: (S, K) sampled neighbor ids, -1 where invalid.
       frontier_cap: static capacity of the output frontier.
+      node_bound: optional static id upper bound enabling the sort-free
+        scatter-min dedup (see masked_unique).
 
     Returns:
       frontier: (frontier_cap,) unique node ids, seeds first, -1 padded.
@@ -135,7 +157,9 @@ def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int):
     nbr_valid = neighbors.reshape(-1) >= 0
     valid = jnp.concatenate([seed_valid, nbr_valid])
 
-    uniq, num_unique, local = masked_unique(ids, valid, frontier_cap, num_forced=S)
+    uniq, num_unique, local = masked_unique(
+        ids, valid, frontier_cap, num_forced=S, node_bound=node_bound
+    )
     col_local = local[S:].reshape(S, K)
     num_frontier = jnp.minimum(num_unique, frontier_cap)
     overflow = jnp.maximum(num_unique - frontier_cap, 0)

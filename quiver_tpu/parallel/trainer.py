@@ -121,6 +121,7 @@ class DistributedTrainer:
             n_id, _, adjs, _, _, _ = multilayer_sample(
                 topo, seeds, num_seeds, sample_key, sizes, caps,
                 weighted=sampler.weighted, kernel=sampler.kernel,
+                dedup=sampler.dedup,
             )
             x = gather_features(hot_table, n_id)
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
@@ -150,6 +151,15 @@ class DistributedTrainer:
         )
         return jax.jit(fn)
 
+
+    def _hot(self):
+        """The raw hot-tier table handed to the shard_map program."""
+        return (
+            self.feature.hot.table
+            if isinstance(self.feature, ShardedFeature)
+            else self.feature.hot
+        )
+
     # -- API ----------------------------------------------------------------
 
     def init(self, rng):
@@ -162,11 +172,7 @@ class DistributedTrainer:
         _, _, adjs, _, _, _ = run(
             self.sampler.topo, jnp.asarray(padded), jnp.int32(m), jax.random.PRNGKey(0)
         )
-        hot = (
-            self.feature.hot.table
-            if isinstance(self.feature, ShardedFeature)
-            else self.feature.hot
-        )
+        hot = self._hot()
         x = jnp.zeros((caps[-1], self.feature.shape[1]), hot.dtype)
         params = self.model.init({"params": rng}, x, adjs)["params"]
         opt_state = self.tx.init(params)
@@ -193,11 +199,7 @@ class DistributedTrainer:
         packed = jax.device_put(
             jnp.asarray(packed), NamedSharding(self.mesh, P(DATA_AXIS))
         )
-        hot = (
-            self.feature.hot.table
-            if isinstance(self.feature, ShardedFeature)
-            else self.feature.hot
-        )
+        hot = self._hot()
         return self._step(
             params, opt_state, self.sampler.topo, hot, packed, labels, key
         )
@@ -249,11 +251,7 @@ class DistributedTrainer:
                 return p, o, losses
 
             self._epoch_cache[steps] = fn
-        hot = (
-            self.feature.hot.table
-            if isinstance(self.feature, ShardedFeature)
-            else self.feature.hot
-        )
+        hot = self._hot()
         packed = jax.device_put(
             jnp.asarray(seed_mat),
             NamedSharding(self.mesh, P(None, DATA_AXIS)),

@@ -83,7 +83,7 @@ def _round_up(x: int, m: int) -> int:
 
 
 def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
-                      kernel="xla", with_eid=False):
+                      kernel="xla", with_eid=False, dedup="sort"):
     """The multi-layer sample+reindex loop (jit- and shard_map-composable).
 
     One trace covers all layers — the fused analogue of the reference's
@@ -133,7 +133,16 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
                 nbr, counts = sample_layer(topo, cur, cur_n, k, sub,
                                            weighted=weighted)
         with trace_scope(f"reindex_layer_{l}"):
-            frontier, n_frontier, col, overflow = reindex_layer(cur, cur_n, nbr, caps[l])
+            # dedup="map": sort-free scatter-min dedup over a dense
+            # (node_count,) position map — the reference's hash-table
+            # analogue (reindex.cu.hpp:120-139); node count is static
+            # from the indptr shape
+            node_bound = (
+                int(topo.indptr.shape[0]) - 1 if dedup == "map" else None
+            )
+            frontier, n_frontier, col, overflow = reindex_layer(
+                cur, cur_n, nbr, caps[l], node_bound=node_bound
+            )
         S = cur.shape[0]
         row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
         row = jnp.where(col >= 0, row, -1)
@@ -183,6 +192,10 @@ class GraphSageSampler:
       with_eid: populate ``Adj.e_id`` with per-edge global edge ids
         (reference sage_sampler.py:100-109) — COO positions when the
         topology tracks ``eid``, CSR slots otherwise. XLA kernel only.
+      dedup: reindex first-occurrence strategy — "sort" (stable sort +
+        run scan) or "map" (sort-free scatter-min into a dense
+        (node_count,) position map, the reference hash-table analogue,
+        reindex.cu.hpp:120-139). Identical results; pick by measurement.
     """
 
     def __init__(
@@ -198,6 +211,7 @@ class GraphSageSampler:
         auto_margin: float = 1.25,
         kernel: str = "xla",
         with_eid: bool = False,
+        dedup: str = "sort",
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -210,6 +224,9 @@ class GraphSageSampler:
         self.kernel = str(kernel)
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
+        self.dedup = str(dedup)
+        if self.dedup not in ("sort", "map"):
+            raise ValueError(f"dedup must be 'sort' or 'map', got {dedup!r}")
         if self.kernel == "pallas":
             if weighted:
                 raise ValueError("kernel='pallas' supports unweighted sampling only")
@@ -293,12 +310,13 @@ class GraphSageSampler:
         weighted = self.weighted
         kernel = self.kernel
         with_eid = self.with_eid
+        dedup = self.dedup
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
             return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
                                      weighted=weighted, kernel=kernel,
-                                     with_eid=with_eid)
+                                     with_eid=with_eid, dedup=dedup)
 
         self._compiled_cache[cache_key] = (run, caps)
         return run, caps
