@@ -181,7 +181,7 @@ def main():
         rec, err, hung = _attempt([], {}, ATTEMPT_TIMEOUT,
                                   f"attempt {n} (default backend)")
         if rec is not None:
-            print(json.dumps(rec))
+            print(json.dumps(rec), flush=True)
             return 0
         errors.append(err)
         if hung:
@@ -199,7 +199,7 @@ def main():
         "fallback (CPU smoke)",
     )
     if rec is not None:
-        print(json.dumps(rec))
+        print(json.dumps(rec), flush=True)
         return 0
     errors.append(err)
 

@@ -115,7 +115,13 @@ def _body(args):
 
     percall_gbps = total_bytes / dt / 1e9
 
-    if args.stream:
+    if args.stream and args.policy == "shard":
+        # ShardedFeature is not a jit-passable pytree (its gather is a
+        # shard_map program built around the store); the stream path would
+        # fail at trace time — say so instead of silently skipping
+        log("--stream applies to --policy replicate only; emitting the "
+            "per-call record for the sharded store")
+    elif args.stream:
         # guarded: a stream failure must not discard the measured per-call
         # number (run_guarded would retry the whole body and degrade)
         try:

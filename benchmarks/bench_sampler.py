@@ -185,7 +185,17 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     from jax import lax
 
     cap = sampler._seed_capacity  # _body always sets seed_capacity=batch
-    run, _ = sampler._compiled(cap)
+    run, caps = sampler._compiled(cap)
+    # int32 tally guard: worst-case valid edges per batch is sum over layers
+    # of (input frontier cap x fanout); clamp the stream so the in-carry
+    # total cannot wrap (user-settable --stream/--batch could otherwise)
+    ins = (cap,) + tuple(caps[:-1])
+    max_edges_per_batch = sum(i * k for i, k in zip(ins, sampler.sizes))
+    max_stream = max(1, (2**31 - 1) // max(max_edges_per_batch, 1))
+    if args.stream > max_stream:
+        log(f"stream clamped {args.stream} -> {max_stream} "
+            f"(int32 edge-tally bound at <= {max_edges_per_batch} edges/batch)")
+        args.stream = max_stream
     rng = np.random.default_rng(args.seed + 13)
     n_vec = jnp.full((args.stream,), jnp.int32(args.batch))
 

@@ -26,7 +26,34 @@ PRODUCTS_AVG_DEG = 50.5
 PRODUCTS_TRAIN_NODES = 196_615
 
 
+def _enable_compilation_cache():
+    """Persistent XLA compilation cache shared across bench processes.
+
+    Every benchmark runs as its own supervised subprocess, and products-scale
+    programs cost minutes of compile each — without a disk cache the
+    scoreboard pays that per job per run. Platform is part of the cache key,
+    so TPU and CPU-fallback runs never collide. Best-effort: an old jax
+    without the API or an unwritable dir must not break a measurement run.
+    """
+    import os
+
+    try:
+        import jax
+
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def base_parser(desc: str) -> argparse.ArgumentParser:
+    _enable_compilation_cache()
     p = argparse.ArgumentParser(description=desc)
     p.add_argument("--nodes", type=int, default=PRODUCTS_NODES)
     p.add_argument("--avg-degree", type=float, default=PRODUCTS_AVG_DEG)
@@ -309,7 +336,7 @@ def run_guarded(body, args):
             "unit": "error",
             "vs_baseline": None,
             "error": last,
-        }))
+        }), flush=True)
         sys.exit(2)
     log("WARNING: measured body unrunnable on this backend; re-exec as CPU "
         f"smoke. (reason: {last})")
@@ -407,5 +434,7 @@ def emit(
     if _DEGRADED_REASON is not None:
         rec["degraded"] = _DEGRADED_REASON
     rec.update(extras)
-    print(json.dumps(rec))
+    # flush: a supervisor timeout-kill must not discard records
+    # sitting in the pipe's block buffer (r3 scoreboard lesson)
+    print(json.dumps(rec), flush=True)
     return rec

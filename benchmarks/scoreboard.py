@@ -38,6 +38,9 @@ JOBS = [
     ("sampler-pallas", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
      "windowed Pallas kernel vs the XLA row above"),
+    ("sampler-dedup-map", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--dedup", "map", "--stream", "128"],
+     "sort-free dense-map reindex vs the sort row above"),
     ("feature-replicate", "benchmarks.bench_feature",
      ["--policy", "replicate", "--stream", "32"],
      "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),

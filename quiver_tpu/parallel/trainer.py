@@ -86,7 +86,7 @@ class DistributedTrainer:
         self.global_batch = self.local_batch * self.data_size
         _, self.caps = sampler._compiled(self.local_batch)
         self._step = self._build()
-        self._epoch_cache: dict[int, object] = {}
+        self._epoch_fn = self._build_epoch()
 
     # -- program ------------------------------------------------------------
 
@@ -218,6 +218,26 @@ class DistributedTrainer:
             for s in range(steps)
         ])
 
+    def _build_epoch(self):
+        step = self._step  # jitted shard_map; inlines under the outer jit
+
+        @jax.jit
+        def fn(params, opt_state, topo, hot, seed_mat, labels, key0):
+            keys = jax.random.split(key0, seed_mat.shape[0])
+
+            def body(carry, xs):
+                p, o = carry
+                seeds, k = xs
+                p, o, loss = step(p, o, topo, hot, seeds, labels, k)
+                return (p, o), loss
+
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state), (seed_mat, keys)
+            )
+            return p, o, losses
+
+        return fn  # jit's shape-keyed cache handles distinct step counts
+
     def epoch_scan(self, params, opt_state, seed_mat, labels, key):
         """A whole epoch as ONE compiled program: ``lax.scan`` over the
         packed per-step seed blocks with (params, opt_state) in the carry.
@@ -230,33 +250,12 @@ class DistributedTrainer:
 
         Returns (params, opt_state, losses[steps]).
         """
-        steps = int(seed_mat.shape[0])
-        fn = self._epoch_cache.get(steps)
-        if fn is None:
-            step = self._step  # jitted shard_map; inlines under the outer jit
-
-            @jax.jit
-            def fn(params, opt_state, topo, hot, seed_mat, labels, key0):
-                keys = jax.random.split(key0, seed_mat.shape[0])
-
-                def body(carry, xs):
-                    p, o = carry
-                    seeds, k = xs
-                    p, o, loss = step(p, o, topo, hot, seeds, labels, k)
-                    return (p, o), loss
-
-                (p, o), losses = jax.lax.scan(
-                    body, (params, opt_state), (seed_mat, keys)
-                )
-                return p, o, losses
-
-            self._epoch_cache[steps] = fn
         hot = self._hot()
         packed = jax.device_put(
             jnp.asarray(seed_mat),
             NamedSharding(self.mesh, P(None, DATA_AXIS)),
         )
-        return fn(
+        return self._epoch_fn(
             params, opt_state, self.sampler.topo, hot, packed, labels, key
         )
 
