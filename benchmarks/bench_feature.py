@@ -115,13 +115,7 @@ def _body(args):
 
     percall_gbps = total_bytes / dt / 1e9
 
-    if args.stream and args.policy == "shard":
-        # ShardedFeature is not a jit-passable pytree (its gather is a
-        # shard_map program built around the store); the stream path would
-        # fail at trace time — say so instead of silently skipping
-        log("--stream applies to --policy replicate only; emitting the "
-            "per-call record for the sharded store")
-    elif args.stream:
+    if args.stream:
         # guarded: a stream failure must not discard the measured per-call
         # number (run_guarded would retry the whole body and degrade)
         try:
@@ -160,17 +154,21 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
         np.stack([batches[i % len(batches)] for i in range(args.stream)])
     )
 
+    # the store is CLOSED OVER, not passed: Feature is a pytree but
+    # ShardedFeature is not (its gather wraps a shard_map program); captured
+    # device buffers are hoisted to program parameters either way, so one
+    # code path serves both policies
     @jax.jit
-    def stream(st, ids_all):
+    def stream(ids_all):
         def step(carry, ids):
-            rows = st[ids]
+            rows = store[ids]
             return carry + jnp.sum(rows.astype(jnp.float32)), None
         total, _ = lax.scan(step, jnp.float32(0), ids_all)
         return total
 
     def one_rep():
         t0 = time.time()
-        float(stream(store, id_mat))
+        float(stream(id_mat))
         dt = time.time() - t0
         nbytes = args.stream * args.gather_batch * (
             store.shape[1] * stored_itemsize + row_overhead

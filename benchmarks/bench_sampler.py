@@ -191,6 +191,12 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     # total cannot wrap (user-settable --stream/--batch could otherwise)
     ins = (cap,) + tuple(caps[:-1])
     max_edges_per_batch = sum(i * k for i, k in zip(ins, sampler.sizes))
+    if max_edges_per_batch > 2**31 - 1:
+        # even ONE batch can wrap the int32 tallies — no stream config is
+        # sound; the per-call record (python-int accumulation) stands
+        log(f"stream skipped: worst-case {max_edges_per_batch} edges/batch "
+            "exceeds the int32 tally range")
+        return
     max_stream = max(1, (2**31 - 1) // max(max_edges_per_batch, 1))
     if args.stream > max_stream:
         log(f"stream clamped {args.stream} -> {max_stream} "

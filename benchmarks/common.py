@@ -53,7 +53,6 @@ def _enable_compilation_cache():
 
 
 def base_parser(desc: str) -> argparse.ArgumentParser:
-    _enable_compilation_cache()
     p = argparse.ArgumentParser(description=desc)
     p.add_argument("--nodes", type=int, default=PRODUCTS_NODES)
     p.add_argument("--avg-degree", type=float, default=PRODUCTS_AVG_DEG)
@@ -312,6 +311,7 @@ def run_guarded(body, args):
 
     retries = getattr(args, "backend_retries", 1)
     delay = getattr(args, "backend_retry_delay", 15.0)
+    _enable_compilation_cache()  # backend plumbing: after argparse, before jax work
     last = None
     for attempt in range(retries + 1):
         try:
