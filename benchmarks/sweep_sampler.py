@@ -1,0 +1,114 @@
+"""Sampler configuration sweep in ONE process: dedup strategies x batch
+sizes, all fused-stream dispatch.
+
+Chip time on the tunnel is dominated by backend init (~min) and per-config
+compiles (~min each, amortized by the persistent cache); running the sweep
+in one process pays init once. Emits one JSON line per configuration
+(same schema as bench_sampler) — feed the winner back into bench.py's
+headline CHILD config.
+
+    python -m benchmarks.sweep_sampler                       # default grid
+    python -m benchmarks.sweep_sampler --batches 2048 8192 --dedups map
+"""
+
+import time
+
+import numpy as np
+
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
+
+BASELINE_UVA_SEPS = 34.29e6
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
+    p.add_argument("--batches", type=int, nargs="+",
+                   default=[2048, 4096, 8192])
+    p.add_argument("--dedups", nargs="+", default=["sort", "map"],
+                   choices=["sort", "map"])
+    p.add_argument("--stream", type=int, default=64)
+    p.add_argument("--reps", type=int, default=3)
+    args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
+
+
+def _stream_once(sampler, topo, batch, stream, rng, reps):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    run, caps = sampler._compiled(batch)
+    ins = (batch,) + tuple(caps[:-1])
+    max_epb = sum(i * k for i, k in zip(ins, sampler.sizes))
+    stream = max(1, min(stream, (2**31 - 1) // max(max_epb, 1)))
+    n_vec = jnp.full((stream,), jnp.int32(batch))
+
+    @jax.jit
+    def streamf(topo_dev, seed_mat, nums, key0):
+        def step(carry, xs):
+            key, total, oflo = carry
+            seeds, n = xs
+            key, sub = jax.random.split(key)
+            _, _, _, overflow, ec, _ = run(topo_dev, seeds, n, sub)
+            return (key, total + jnp.sum(jnp.stack(ec)), oflo + overflow), None
+        init = (key0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        (_, total, oflo), _ = lax.scan(step, init, (seed_mat, nums))
+        return total, oflo
+
+    def one_rep():
+        seed_np = rng.integers(0, topo.node_count, (stream, batch)).astype(np.int32)
+        key = jax.random.PRNGKey(int(rng.integers(1 << 30)))
+        t0 = time.time()
+        total, oflo = streamf(sampler.topo, jnp.asarray(seed_np), n_vec, key)
+        total, oflo = int(total), int(oflo)
+        return total / (time.time() - t0), oflo
+
+    t0 = time.time()
+    one_rep()  # compile
+    log(f"  compile {time.time()-t0:.1f}s (stream={stream})")
+    results = [one_rep() for _ in range(reps)]
+    return float(np.median([r[0] for r in results])), results[-1][1], stream
+
+
+def _body(args):
+    from quiver_tpu import GraphSageSampler
+
+    topo = build_graph(args)
+    rng = np.random.default_rng(args.seed)
+
+    for dedup in args.dedups:
+        for batch in args.batches:
+            log(f"config dedup={dedup} batch={batch}")
+            sampler = GraphSageSampler(
+                topo, args.fanout, mode="HBM", seed_capacity=batch,
+                seed=args.seed, dedup=dedup, frontier_caps="auto",
+            )
+            # plan auto caps from one eager batch
+            sampler.sample(rng.integers(0, topo.node_count, batch))
+            try:
+                seps, oflo, stream = _stream_once(
+                    sampler, topo, batch, args.stream, rng, args.reps
+                )
+            except Exception as e:  # noqa: BLE001 — one config must not kill the sweep
+                log(f"  config failed: {type(e).__name__}: {str(e)[:200]}")
+                continue
+            emit(
+                "sampled-edges/sec/chip",
+                seps,
+                "SEPS",
+                BASELINE_UVA_SEPS,
+                mode="HBM",
+                kernel="xla",
+                fanout=args.fanout,
+                batch=batch,
+                caps="auto",
+                dedup=dedup,
+                dispatch="stream",
+                stream_batches=stream,
+                overflow=oflo,
+            )
+
+
+if __name__ == "__main__":
+    main()
