@@ -16,9 +16,15 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
-
-BASELINE_UVA_SEPS = 34.29e6
+from benchmarks.common import (
+    BASELINE_UVA_SEPS,
+    base_parser,
+    build_graph,
+    emit,
+    log,
+    run_guarded,
+    stream_seps,
+)
 
 
 def main():
@@ -170,69 +176,21 @@ def _stage_profile(args, sampler, topo, reps: int = 30):
 
 
 def _stream_seps(args, sampler, topo, reps: int = 3):
-    """SEPS over a fused seed stream: ONE compiled program scans args.stream
-    batches, tallying valid edges in-carry; the host sees one scalar.
+    """Fused-stream headline (see benchmarks.common.stream_seps).
 
     Methodology note: per-batch outputs (Adj stacks) are produced and
     discarded inside the scan — the sample + reindex compute that defines
     SEPS is all live (the tallies depend on it); only the final
     reshape/stack assembly is dead code. Timed wall includes the seed
     matrix H2D and the scalar readback. Valid edges only (BASELINE.md
-    honesty rule); per-scan totals stay < 2^31 for stream sizes here.
+    honesty rule).
     """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    cap = sampler._seed_capacity  # _body always sets seed_capacity=batch
-    run, caps = sampler._compiled(cap)
-    # int32 tally guard: worst-case valid edges per batch is sum over layers
-    # of (input frontier cap x fanout); clamp the stream so the in-carry
-    # total cannot wrap (user-settable --stream/--batch could otherwise)
-    ins = (cap,) + tuple(caps[:-1])
-    max_edges_per_batch = sum(i * k for i, k in zip(ins, sampler.sizes))
-    if max_edges_per_batch > 2**31 - 1:
-        # even ONE batch can wrap the int32 tallies — no stream config is
-        # sound; the per-call record (python-int accumulation) stands
-        log(f"stream skipped: worst-case {max_edges_per_batch} edges/batch "
-            "exceeds the int32 tally range")
-        return
-    max_stream = max(1, (2**31 - 1) // max(max_edges_per_batch, 1))
-    if args.stream > max_stream:
-        log(f"stream clamped {args.stream} -> {max_stream} "
-            f"(int32 edge-tally bound at <= {max_edges_per_batch} edges/batch)")
-        args.stream = max_stream
     rng = np.random.default_rng(args.seed + 13)
-    n_vec = jnp.full((args.stream,), jnp.int32(args.batch))
-
-    @jax.jit
-    def stream(topo_dev, seed_mat, nums, key0):
-        def step(carry, xs):
-            key, total, oflo = carry
-            seeds, n = xs
-            key, sub = jax.random.split(key)
-            _, _, _, overflow, ec, _ = run(topo_dev, seeds, n, sub)
-            total = total + jnp.sum(jnp.stack(ec))
-            return (key, total, oflo + overflow), None
-        init = (key0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
-        (_, total, oflo), _ = lax.scan(step, init, (seed_mat, nums))
-        return total, oflo
-
-    def one_rep():
-        seed_np = rng.integers(
-            0, topo.node_count, (args.stream, cap)
-        ).astype(np.int32)
-        key = jax.random.PRNGKey(int(rng.integers(1 << 30)))
-        t0 = time.time()
-        total, oflo = stream(sampler.topo, jnp.asarray(seed_np), n_vec, key)
-        total, oflo = int(total), int(oflo)
-        return total / (time.time() - t0), total, oflo
-
-    t0 = time.time()
-    one_rep()  # compile
-    log(f"stream compile: {time.time()-t0:.1f}s ({args.stream} batches/scan)")
-    results = [one_rep() for _ in range(reps)]
-    seps = float(np.median([r[0] for r in results]))
+    cap = sampler._seed_capacity  # _body always sets seed_capacity=batch
+    res = stream_seps(sampler, topo.node_count, cap, args.stream, rng, reps)
+    if res is None:
+        return
+    seps, oflo, stream = res
     emit(
         "sampled-edges/sec/chip",
         seps,
@@ -245,8 +203,8 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
         caps=args.caps,
         dedup=args.dedup,
         dispatch="stream",
-        stream_batches=args.stream,
-        overflow=int(results[-1][2]),
+        stream_batches=stream,
+        overflow=oflo,
     )
 
 

@@ -25,6 +25,68 @@ PRODUCTS_NODES = 2_450_000
 PRODUCTS_AVG_DEG = 50.5
 PRODUCTS_TRAIN_NODES = 196_615
 
+# reference 1-GPU UVA SEPS on ogbn-products [15,10,5] (Introduction_en.md:41)
+BASELINE_UVA_SEPS = 34.29e6
+
+
+def stream_seps(sampler, node_count: int, batch: int, stream: int, rng,
+                reps: int = 3):
+    """Shared fused-stream SEPS measurement: ONE compiled program scans
+    ``stream`` seed batches (in-program valid-edge tallies, one scalar
+    readback). Used by bench_sampler's --stream headline and sweep_sampler.
+
+    Returns (median SEPS, last overflow, stream actually used), or None
+    when even a single batch's worst-case edge count would wrap the int32
+    in-carry tally (no stream config is sound then — the caller's per-call
+    number stands).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    run, caps = sampler._compiled(batch)
+    ins = (batch,) + tuple(caps[:-1])
+    max_epb = sum(i * k for i, k in zip(ins, sampler.sizes))
+    if max_epb > 2**31 - 1:
+        log(f"stream skipped: worst-case {max_epb} edges/batch exceeds the "
+            "int32 tally range")
+        return None
+    max_stream = max(1, (2**31 - 1) // max(max_epb, 1))
+    if stream > max_stream:
+        log(f"stream clamped {stream} -> {max_stream} "
+            f"(int32 edge-tally bound at <= {max_epb} edges/batch)")
+        stream = max_stream
+    n_vec = jnp.full((stream,), jnp.int32(batch))
+
+    @jax.jit
+    def streamf(topo_dev, seed_mat, nums, key0):
+        def step(carry, xs):
+            key, total, oflo = carry
+            seeds, n = xs
+            key, sub = jax.random.split(key)
+            _, _, _, overflow, ec, _ = run(topo_dev, seeds, n, sub)
+            return (key, total + jnp.sum(jnp.stack(ec)), oflo + overflow), None
+        init = (key0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        (_, total, oflo), _ = lax.scan(step, init, (seed_mat, nums))
+        return total, oflo
+
+    import numpy as np
+
+    def one_rep():
+        seed_np = rng.integers(0, node_count, (stream, batch)).astype(np.int32)
+        key = jax.random.PRNGKey(int(rng.integers(1 << 30)))
+        t0 = time.time()
+        total, oflo = streamf(sampler.topo, jnp.asarray(seed_np), n_vec, key)
+        total, oflo = int(total), int(oflo)
+        return total / (time.time() - t0), oflo
+
+    t0 = time.time()
+    one_rep()  # compile
+    log(f"stream compile: {time.time()-t0:.1f}s ({stream} batches/scan)")
+    results = [one_rep() for _ in range(reps)]
+    seps = float(np.median([r[0] for r in results]))
+    return seps, results[-1][1], stream
+
 
 def _enable_compilation_cache():
     """Persistent XLA compilation cache shared across bench processes.
@@ -37,6 +99,14 @@ def _enable_compilation_cache():
     """
     import os
 
+    # forced-CPU runs (smokes, fallbacks) skip the cache: CPU executables
+    # are cheap to compile, and cached ones carry machine-feature flags
+    # that trip cross-host AOT loader warnings
+    plats = [p.strip().lower()
+             for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+             if p.strip()]
+    if plats == ["cpu"]:
+        return
     try:
         import jax
 

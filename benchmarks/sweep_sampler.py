@@ -3,7 +3,9 @@ sizes, all fused-stream dispatch.
 
 Chip time on the tunnel is dominated by backend init (~min) and per-config
 compiles (~min each, amortized by the persistent cache); running the sweep
-in one process pays init once. Emits one JSON line per configuration
+in one process pays init once, and every sampler shares ONE device-resident
+topology (GraphSageSampler(device_topo=...)) so the ~500MB CSR crosses the
+link once, not once per configuration. Emits one JSON line per config
 (same schema as bench_sampler) — feed the winner back into bench.py's
 headline CHILD config.
 
@@ -11,13 +13,17 @@ headline CHILD config.
     python -m benchmarks.sweep_sampler --batches 2048 8192 --dedups map
 """
 
-import time
-
 import numpy as np
 
-from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
-
-BASELINE_UVA_SEPS = 34.29e6
+from benchmarks.common import (
+    BASELINE_UVA_SEPS,
+    base_parser,
+    build_graph,
+    emit,
+    log,
+    run_guarded,
+    stream_seps,
+)
 
 
 def main():
@@ -33,49 +39,13 @@ def main():
     run_guarded(lambda: _body(args), args)
 
 
-def _stream_once(sampler, topo, batch, stream, rng, reps):
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    run, caps = sampler._compiled(batch)
-    ins = (batch,) + tuple(caps[:-1])
-    max_epb = sum(i * k for i, k in zip(ins, sampler.sizes))
-    stream = max(1, min(stream, (2**31 - 1) // max(max_epb, 1)))
-    n_vec = jnp.full((stream,), jnp.int32(batch))
-
-    @jax.jit
-    def streamf(topo_dev, seed_mat, nums, key0):
-        def step(carry, xs):
-            key, total, oflo = carry
-            seeds, n = xs
-            key, sub = jax.random.split(key)
-            _, _, _, overflow, ec, _ = run(topo_dev, seeds, n, sub)
-            return (key, total + jnp.sum(jnp.stack(ec)), oflo + overflow), None
-        init = (key0, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
-        (_, total, oflo), _ = lax.scan(step, init, (seed_mat, nums))
-        return total, oflo
-
-    def one_rep():
-        seed_np = rng.integers(0, topo.node_count, (stream, batch)).astype(np.int32)
-        key = jax.random.PRNGKey(int(rng.integers(1 << 30)))
-        t0 = time.time()
-        total, oflo = streamf(sampler.topo, jnp.asarray(seed_np), n_vec, key)
-        total, oflo = int(total), int(oflo)
-        return total / (time.time() - t0), oflo
-
-    t0 = time.time()
-    one_rep()  # compile
-    log(f"  compile {time.time()-t0:.1f}s (stream={stream})")
-    results = [one_rep() for _ in range(reps)]
-    return float(np.median([r[0] for r in results])), results[-1][1], stream
-
-
 def _body(args):
     from quiver_tpu import GraphSageSampler
+    from quiver_tpu.core.config import SampleMode
 
     topo = build_graph(args)
     rng = np.random.default_rng(args.seed)
+    dev_topo = topo.to_device(SampleMode.HBM)  # shared across every config
 
     for dedup in args.dedups:
         for batch in args.batches:
@@ -83,16 +53,21 @@ def _body(args):
             sampler = GraphSageSampler(
                 topo, args.fanout, mode="HBM", seed_capacity=batch,
                 seed=args.seed, dedup=dedup, frontier_caps="auto",
+                device_topo=dev_topo,
             )
             # plan auto caps from one eager batch
             sampler.sample(rng.integers(0, topo.node_count, batch))
             try:
-                seps, oflo, stream = _stream_once(
-                    sampler, topo, batch, args.stream, rng, args.reps
+                res = stream_seps(
+                    sampler, topo.node_count, batch, args.stream, rng,
+                    args.reps,
                 )
             except Exception as e:  # noqa: BLE001 — one config must not kill the sweep
                 log(f"  config failed: {type(e).__name__}: {str(e)[:200]}")
                 continue
+            if res is None:
+                continue
+            seps, oflo, stream = res
             emit(
                 "sampled-edges/sec/chip",
                 seps,

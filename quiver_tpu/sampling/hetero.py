@@ -113,7 +113,7 @@ def _normalize_sizes(sizes, topo: HeteroCSRTopo):
 
 def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
                              layer_plans, weighted_rels=frozenset(),
-                             with_eid: bool = False):
+                             with_eid: bool = False, node_bounds=None):
     """The jit-composable hetero sampling loop.
 
     ``layer_plans`` is a static tuple of per-hop plans, each
@@ -124,6 +124,9 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
     ``with_eid`` threads per-edge global edge ids into every Adj — the
     homogeneous contract (multilayer_sample, sampler.py) extended to typed
     relations: ids are COO positions within each relation's own edge list.
+    ``node_bounds`` (static {type: node_count} or None) switches the
+    per-type dedup to the sort-free dense-map scatter-min, matching the
+    homogeneous ``dedup='map'`` option.
     Returns (frontier dict, counts dict, layers deepest-first, overflow).
     """
     frontier = {input_type: seeds}
@@ -172,8 +175,10 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
                 valids.append(flat >= 0)
             ids = jnp.concatenate(blocks)
             valid = jnp.concatenate(valids)
-            uniq, num_u, local = masked_unique(ids, valid, cap,
-                                               num_forced=n_prev)
+            uniq, num_u, local = masked_unique(
+                ids, valid, cap, num_forced=n_prev,
+                node_bound=None if node_bounds is None else node_bounds[t],
+            )
             new_frontier[t] = uniq
             new_counts[t] = jnp.minimum(num_u, cap)
             layer_uniques[t] = num_u
@@ -235,6 +240,10 @@ class HeteroGraphSampler:
       with_eid: populate every ``Adj.e_id`` with relation-local global edge
         ids (COO positions) — the homogeneous sampler's contract
         (sage_sampler.py:100-109 parity) extended to typed graphs.
+      dedup: per-type frontier first-occurrence strategy — "sort" (stable
+        sort + run scan) or "map" (sort-free scatter-min into a dense
+        per-type position map). Identical results; pick by measurement.
+        Mirrors the homogeneous GraphSageSampler option.
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
@@ -242,9 +251,12 @@ class HeteroGraphSampler:
                  seed_capacity: int | None = None,
                  frontier_caps: str | None = None, seed: int = 0,
                  auto_margin: float = 1.25, weighted=False,
-                 with_eid: bool = False):
+                 with_eid: bool = False, dedup: str = "sort"):
         if input_type not in topo.num_nodes:
             raise ValueError(f"unknown input_type {input_type!r}")
+        self.dedup = str(dedup)
+        if self.dedup not in ("sort", "map"):
+            raise ValueError(f"dedup must be 'sort' or 'map', got {dedup!r}")
         self.topo = topo
         self.input_type = input_type
         self.sizes = _normalize_sizes(sizes, topo)
@@ -359,12 +371,17 @@ class HeteroGraphSampler:
         input_type = self.input_type
         weighted_rels = self.weighted_rels
         with_eid = self.with_eid
+        node_bounds = (
+            {t: int(n) for t, n in self.topo.num_nodes.items()}
+            if self.dedup == "map" else None
+        )
 
         @jax.jit
         def run(dev_topos, seeds, num_seeds, key):
             return hetero_multilayer_sample(
                 dev_topos, seeds, num_seeds, key, input_type, plans,
                 weighted_rels=weighted_rels, with_eid=with_eid,
+                node_bounds=node_bounds,
             )
 
         self._compiled_cache[cache_key] = run

@@ -196,6 +196,9 @@ class GraphSageSampler:
         run scan) or "map" (sort-free scatter-min into a dense
         (node_count,) position map, the reference hash-table analogue,
         reindex.cu.hpp:120-139). Identical results; pick by measurement.
+      device_topo: advanced — reuse an existing DeviceTopology (built with
+        compatible to_device flags) instead of uploading a fresh copy;
+        lets many sampler configurations share one device-resident graph.
     """
 
     def __init__(
@@ -212,6 +215,7 @@ class GraphSageSampler:
         kernel: str = "xla",
         with_eid: bool = False,
         dedup: str = "sort",
+        device_topo=None,
     ):
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
@@ -239,9 +243,26 @@ class GraphSageSampler:
                 "weighted=True requires edge weights; call "
                 "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
             )
-        self.topo = csr_topo.to_device(
-            self.mode, with_eid=self.with_eid, with_weights=self.weighted
-        )
+        if device_topo is not None:
+            # advanced: share one DeviceTopology across samplers (the
+            # reference shares one native quiver across sampler objects
+            # too); must have been built with to_device flags compatible
+            # with this sampler's mode/with_eid/weighted
+            if self.with_eid and getattr(device_topo, "eid", None) is None:
+                raise ValueError(
+                    "device_topo lacks eid but with_eid=True; rebuild with "
+                    "to_device(with_eid=True)"
+                )
+            if self.weighted and getattr(device_topo, "cum_weights", None) is None:
+                raise ValueError(
+                    "device_topo lacks cum_weights but weighted=True; "
+                    "rebuild with to_device(with_weights=True)"
+                )
+            self.topo = device_topo
+        else:
+            self.topo = csr_topo.to_device(
+                self.mode, with_eid=self.with_eid, with_weights=self.weighted
+            )
         self._seed_capacity = seed_capacity
         self._auto_caps = frontier_caps == "auto"
         self._auto_margin = float(auto_margin)
