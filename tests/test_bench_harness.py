@@ -122,3 +122,45 @@ def test_supervised_child_contract():
     finally:
         del os.environ["QUIVER_BENCH_SUPERVISED"]
     assert not common._supervised()
+
+
+def test_stream_seps_int32_guard():
+    """The shared fused-stream helper must refuse configs whose single-batch
+    worst-case edge count wraps int32, and clamp oversized stream lengths."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+    import jax.numpy as jnp
+
+    from benchmarks import common
+
+    class _StubSampler:
+        """caps/sizes chosen so max_edges_per_batch ~= 4.2e9 > 2^31-1."""
+        sizes = (1000, 1000, 1000)
+        topo = jnp.zeros(4, jnp.int32)
+
+        def _compiled(self, batch):
+            def run(topo, seeds, n, key):
+                raise AssertionError("run must not execute when guarded out")
+            return run, (2**21, 2**21, 2**21)
+
+    rng = np.random.default_rng(0)
+    assert common.stream_seps(_StubSampler(), 100, 2048, 64, rng) is None
+
+    class _SmallSampler:
+        """max_edges_per_batch = 8*2 + 16*2 + 16*2 = 80 -> max_stream huge;
+        a tiny real-ish run validates the tally path end to end."""
+        sizes = (2, 2)
+        topo = jnp.zeros(4, jnp.int32)
+
+        def _compiled(self, batch):
+            S = batch
+
+            def run(topo, seeds, n, key):
+                ec = (jnp.int32(3), jnp.int32(5))
+                return (seeds, n, (), jnp.int32(0), ec, (n, n))
+            return run, (16, 16)
+
+    res = common.stream_seps(_SmallSampler(), 100, 8, 4, rng, reps=2)
+    assert res is not None
+    seps, oflo, stream = res
+    assert stream == 4 and oflo == 0 and seps > 0
