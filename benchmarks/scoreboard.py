@@ -29,42 +29,44 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # (key, module, args, baseline note)
 JOBS = [
+    # ordered: highest-evidence rows first, so a short chip window still
+    # lands the headline stream/scan numbers before the long-tail jobs
     ("sampler-hbm", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--stages", "--stream", "128"],
      "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41)"),
-    ("sampler-host", "benchmarks.bench_sampler",
-     ["--mode", "HOST", "--stream", "128"],
-     "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
-    ("sampler-pallas", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
-     "windowed Pallas kernel vs the XLA row above"),
     ("sampler-dedup-map", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--dedup", "map", "--stream", "128"],
      "sort-free dense-map reindex vs the sort row above"),
     ("feature-replicate", "benchmarks.bench_feature",
      ["--policy", "replicate", "--stream", "32"],
      "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),
-    ("feature-replicate-xla", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--kernel", "xla", "--stream", "32"],
-     "XLA-gather control for the kernel=auto row"),
-    ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
-     "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
     ("epoch-scan", "benchmarks.bench_epoch", ["--scan-epoch", "--bf16"],
      "whole epoch as ONE compiled program, bf16 — the TPU-native epoch "
      "loop, measured directly (vs ref 11.1 s, Introduction_en.md:146-149)"),
+    ("sampler-host", "benchmarks.bench_sampler",
+     ["--mode", "HOST", "--stream", "128"],
+     "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
+    ("sampler-pallas", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
+     "windowed Pallas kernel vs the XLA row above"),
+    ("feature-replicate-xla", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--kernel", "xla", "--stream", "32"],
+     "XLA-gather control for the kernel=auto row"),
+    ("feature-bf16", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--dtype", "bf16", "--stream", "32"],
+     "bf16 rows: 2x rows/s at equal GB/s, 2x cache rows per budget"),
+    ("feature-int8", "benchmarks.bench_feature",
+     ["--policy", "replicate", "--dtype", "int8", "--stream", "32"],
+     "int8 quantized rows (absmax/row): ~4x cache rows per budget"),
+    ("epoch-fused-bf16", "benchmarks.bench_epoch", ["--fused", "--bf16"],
+     "fused + mixed precision: the framework's best-case per-step config"),
+    ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
+     "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
     ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
      "mixed-precision (bf16 MXU matmuls + bf16 feature rows) vs the f32 row"),
     ("epoch-fused", "benchmarks.bench_epoch", ["--fused"],
      "ONE XLA program per step, full-HBM table — vs ref 11.1s AND its "
      "PyG-all-on-GPU 23.3s (Introduction_en.md:153-158)"),
-    ("epoch-fused-bf16", "benchmarks.bench_epoch", ["--fused", "--bf16"],
-     "fused + mixed precision: the framework's best-case configuration"),
-    ("feature-bf16", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--dtype", "bf16"],
-     "bf16 rows: 2x rows/s at equal GB/s, 2x cache rows per budget"),
-    ("feature-int8", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--dtype", "int8"],
-     "int8 quantized rows (absmax/row): ~4x cache rows per budget"),
     ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"],
      "beyond-HBM topology placement"),
     ("rgcn", "benchmarks.bench_rgcn", [],
