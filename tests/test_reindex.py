@@ -123,6 +123,33 @@ def test_sampler_dedup_map_matches_sort():
         )
 
 
+def test_sampler_device_topo_reuse():
+    """Samplers sharing one prebuilt DeviceTopology must behave exactly like
+    samplers that upload their own copy, and incompatible reuse is rejected."""
+    import pytest
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.core.config import SampleMode
+
+    rng = np.random.default_rng(11)
+    ei = np.stack([rng.integers(0, 300, 2500), rng.integers(0, 300, 2500)])
+    topo = CSRTopo(edge_index=ei)
+    dev = topo.to_device(SampleMode.HBM)
+    seeds = rng.integers(0, topo.node_count, 48)
+
+    own = GraphSageSampler(topo, [4, 3], seed=5)
+    shared = GraphSageSampler(topo, [4, 3], seed=5, device_topo=dev)
+    a, b = own.sample(seeds), shared.sample(seeds)
+    assert np.array_equal(np.asarray(a.n_id), np.asarray(b.n_id))
+    for adj_a, adj_b in zip(a.adjs, b.adjs):
+        assert np.array_equal(
+            np.asarray(adj_a.edge_index), np.asarray(adj_b.edge_index)
+        )
+
+    with pytest.raises(ValueError, match="eid"):
+        GraphSageSampler(topo, [4], seed=0, with_eid=True, device_topo=dev)
+
+
 def test_reindex_layer_matches_reference():
     rng = np.random.default_rng(1)
     S, K = 16, 5
