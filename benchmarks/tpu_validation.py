@@ -90,22 +90,20 @@ def frequency_test(topo, dev, fanout, trials, seed):
 
 
 def bench_seps(sampler_cls, topo, fanouts, batch, iters, seed, kernel):
-    import jax
+    """Stream-dispatch SEPS (benchmarks.common.stream_seps): the xla-vs-
+    pallas ratio must reflect kernel compute, not the ~90ms/iter tunnel
+    sync a per-call loop would add identically to both sides.
+
+    Returns (seps, overflow, stream_batches) or None (int32 guard)."""
+    from benchmarks.common import stream_seps
 
     sampler = sampler_cls(
         topo, fanouts, seed_capacity=batch, seed=seed, kernel=kernel
     )
     rng = np.random.default_rng(seed)
-    for _ in range(10):
-        out = sampler.sample(rng.integers(0, topo.node_count, batch))
-    jax.block_until_ready(out.n_id)
-    total = 0
-    t0 = time.time()
-    for _ in range(iters):
-        out = sampler.sample(rng.integers(0, topo.node_count, batch))
-        total += int(sum(out.edge_counts))
-    jax.block_until_ready(out.n_id)
-    return total / (time.time() - t0)
+    # iters is the stream length (smoke mode shrinks it); worst-case caps
+    # are deterministic here, so no eager planning call is needed
+    return stream_seps(sampler, topo.node_count, batch, iters, rng, reps=3)
 
 
 def main():
@@ -151,12 +149,15 @@ def _body(args):
     import jax.numpy as jnp
 
     for kernel in ("xla", "pallas"):
-        seps = bench_seps(
+        res = bench_seps(
             GraphSageSampler, topo, args.fanout, args.batch, args.iters,
             args.seed, kernel,
         )
-        emit("sampler-seps", seps, "SEPS", 34.29e6, kernel=kernel,
-             fanout=args.fanout, batch=args.batch)
+        if res is not None:
+            seps, oflo, stream = res
+            emit("sampler-seps", seps, "SEPS", 34.29e6, kernel=kernel,
+                 fanout=args.fanout, batch=args.batch, dispatch="stream",
+                 stream_batches=stream, overflow=oflo)
 
     # 4. gather GB/s head-to-head
     n_rows = min(topo.node_count, 1_000_000)

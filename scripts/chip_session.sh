@@ -1,0 +1,38 @@
+#!/bin/bash
+# Full chip session: probes the tunneled TPU until it answers, then runs
+# the complete on-hardware evidence pass in order of value:
+#   1. scoreboard   -> regenerates docs/TPU_RESULTS.md (platform=tpu rows)
+#   2. config sweep -> docs/sweep_r3.log (dedup x batch stream SEPS)
+#   3. acceptance   -> docs/acceptance_tpu_r3.log (planted-SBM training)
+#   4. headline     -> docs/headline_r3.log (repo-root bench.py)
+# Never hard-kill a running TPU process (a kill wedges the chip ~10+ min;
+# see docs/TPU_MEASUREMENTS_R3.md "Operational notes").
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+log(){ echo "[chip-session] $*"; }
+for i in $(seq 1 "${CHIP_SESSION_PROBES:-400}"); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+jnp.zeros(8).block_until_ready()
+assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
+    log "chip answered on probe $i at $(date -u +%H:%M:%S)"
+    sleep 10
+    log "=== scoreboard ==="
+    QUIVER_BENCH_TIMEOUT="${QUIVER_BENCH_TIMEOUT:-2400}" python -m benchmarks.scoreboard
+    log "=== sweep ==="
+    QUIVER_BENCH_SUPERVISED=1 timeout 3600 python -m benchmarks.sweep_sampler --stream 64 > docs/sweep_r3.log 2>&1
+    log "sweep rc=$? (docs/sweep_r3.log)"
+    log "=== acceptance training (planted SBM) ==="
+    timeout 2400 python -m examples.train_sage --dataset planted:50000 --epochs 3 > docs/acceptance_tpu_r3.log 2>&1
+    log "acceptance rc=$? (docs/acceptance_tpu_r3.log)"
+    log "=== headline bench.py ==="
+    timeout 2400 python bench.py > docs/headline_r3.log 2>&1
+    log "headline rc=$? (docs/headline_r3.log)"
+    log "done at $(date -u +%H:%M:%S)"
+    exit 0
+  fi
+  log "probe $i failed at $(date -u +%H:%M:%S); sleeping 150s"
+  sleep 150
+done
+log "gave up"
+exit 1
