@@ -164,3 +164,56 @@ def test_stream_seps_int32_guard():
     assert res is not None
     seps, oflo, stream = res
     assert stream == 4 and oflo == 0 and seps > 0
+
+
+def test_scoreboard_run_job_retry_and_fallback(monkeypatch):
+    """run_job retries once on a fast error, then degrades to the labeled
+    CPU smoke; timeouts skip the retry (a hung tunnel must not burn a
+    second full budget)."""
+    sys.path.insert(0, REPO)
+    from benchmarks import scoreboard
+
+    calls = []
+
+    def fake_run_once(module, extra, env, timeout_s):
+        calls.append((tuple(extra), env.get("JAX_PLATFORMS")))
+        if len(calls) <= 2:
+            return [], "boom rc=1"
+        return [{"metric": "m", "value": 1}], None
+
+    monkeypatch.setattr(scoreboard, "_run_once", fake_run_once)
+    monkeypatch.setattr(scoreboard.time, "sleep", lambda s: None)
+    recs, err, _ = scoreboard.run_job("mod", ["--x"], smoke=False, timeout_s=5)
+    assert recs and err is None
+    # attempt, retry, then CPU-smoke fallback with the degraded label
+    assert len(calls) == 3
+    assert calls[2][1] == "cpu" and "--smoke" in calls[2][0]
+
+    calls.clear()
+
+    def fake_timeout(module, extra, env, timeout_s):
+        calls.append((tuple(extra), env.get("JAX_PLATFORMS")))
+        if len(calls) == 1:
+            return [], "timeout>5s"
+        return [{"metric": "m", "value": 2}], None
+
+    monkeypatch.setattr(scoreboard, "_run_once", fake_timeout)
+    recs, err, _ = scoreboard.run_job("mod", [], smoke=False, timeout_s=5)
+    assert recs and err is None
+    # no same-backend retry after a hang: straight to the CPU fallback
+    assert len(calls) == 2 and calls[1][1] == "cpu"
+
+
+def test_scoreboard_timeout_keeps_partial_records(monkeypatch):
+    """A job killed at its timeout must keep records already flushed to
+    stdout (the round-3 lesson: emit flushes exactly so this works)."""
+    sys.path.insert(0, REPO)
+    from benchmarks import scoreboard
+
+    def fake_run_once(module, extra, env, timeout_s):
+        return [{"metric": "sampled-edges/sec/chip", "value": 3}], "timeout>5s"
+
+    monkeypatch.setattr(scoreboard, "_run_once", fake_run_once)
+    recs, err, _ = scoreboard.run_job("mod", [], smoke=False, timeout_s=5)
+    assert recs == [{"metric": "sampled-edges/sec/chip", "value": 3}]
+    assert str(err).startswith("timeout")
