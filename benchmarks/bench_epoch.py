@@ -73,6 +73,13 @@ def main():
         "placement rules (full-HBM feature table)",
     )
     p.add_argument(
+        "--seed-sharding", default="data", choices=["data", "all"],
+        help="fused/scan modes: seed-block placement (see "
+        "DistributedTrainer) — 'all' makes every device a data worker "
+        "with the routed all_to_all sharded gather; only differs from "
+        "'data' when the mesh's feature axis > 1",
+    )
+    p.add_argument(
         "--bf16", action="store_true",
         help="bfloat16 feature storage + mixed-precision model compute "
         "(f32 params, bf16 MXU matmuls) — the TPU-first precision recipe "
@@ -220,18 +227,22 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
 
     n = topo.node_count
     mesh = make_mesh()
-    # ceil: shard_seeds' first blocks get ceil(batch/data) seeds
-    local_batch = -(-args.batch // mesh.shape["data"])
+    workers = mesh.shape["data"] * (
+        mesh.shape["feature"] if args.seed_sharding == "all" else 1
+    )
+    # ceil: shard_seeds' first blocks get ceil(batch/workers) seeds
+    local_batch = -(-args.batch // workers)
     # a dedicated sampler sized to the PER-DEVICE block, with auto caps
     # planned from a local-batch draw — planning at the global batch would
-    # leave every device running frontiers ~data-size too wide
+    # leave every device running frontiers ~worker-count too wide
     sampler = GraphSageSampler(
         topo, args.fanout, mode="HBM", seed_capacity=local_batch,
         seed=args.seed, frontier_caps="auto",
     )
     sampler.sample(rng.integers(0, n, local_batch))
     trainer = DistributedTrainer(
-        mesh, sampler, feature, model, tx, local_batch=local_batch
+        mesh, sampler, feature, model, tx, local_batch=local_batch,
+        seed_sharding=args.seed_sharding,
     )
     params, opt_state = trainer.init(jax.random.PRNGKey(0))
 
@@ -270,14 +281,18 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
 
     n = topo.node_count
     mesh = make_mesh()
-    local_batch = -(-args.batch // mesh.shape["data"])
+    workers = mesh.shape["data"] * (
+        mesh.shape["feature"] if args.seed_sharding == "all" else 1
+    )
+    local_batch = -(-args.batch // workers)
     sampler = GraphSageSampler(
         topo, args.fanout, mode="HBM", seed_capacity=local_batch,
         seed=args.seed, frontier_caps="auto",
     )
     sampler.sample(rng.integers(0, n, local_batch))
     trainer = DistributedTrainer(
-        mesh, sampler, feature, model, tx, local_batch=local_batch
+        mesh, sampler, feature, model, tx, local_batch=local_batch,
+        seed_sharding=args.seed_sharding,
     )
     params, opt_state = trainer.init(jax.random.PRNGKey(0))
     train_idx = rng.permutation(n)[: args.train_nodes]
@@ -314,6 +329,7 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
         batch=args.batch,
         model=args.model,
         mode="FUSED-SCAN",
+        seed_sharding=args.seed_sharding,
         bf16=bool(args.bf16),
         cache_ratio=args.cache_ratio,
         train_nodes=args.train_nodes,

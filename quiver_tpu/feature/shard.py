@@ -89,7 +89,8 @@ class ShardedTensor(KernelChoice):
         """Per-device body: serve the ids this shard owns, zeros elsewhere.
 
         Call inside ``shard_map``; combine across shards with
-        ``psum(..., self.axis)``.
+        ``psum(..., self.axis)``. Requires every member of the feature
+        group to request the SAME ids (the psum aligns rows by position).
         """
         my = jax.lax.axis_index(self.axis)
         owner = ids // self.rows_per_shard
@@ -97,6 +98,67 @@ class ShardedTensor(KernelChoice):
         local_idx = jnp.where(mine, ids - my * self.rows_per_shard, 0)
         rows = _hot_gather_fn(local_table, self.kernel)(local_idx)
         return jnp.where(mine[:, None], rows, 0)
+
+    def routed_gather(self, local_table, ids):
+        """Per-device body: serve a DIFFERENT id set per feature-group
+        member by routing requests to their owning shard and rows back —
+        two ``all_to_all`` hops over the feature axis.
+
+        This is the true analogue of the reference's NVLink-clique gather
+        (shard_tensor.cu.hpp:16-58: every GPU runs its own batch and loads
+        peer HBM directly): with it, the feature axis no longer forces
+        redundant sampling/model work across the group — each device can be
+        a full data worker over its own seed block while the table stays
+        sharded (see docs/Introduction.md "Cost of redundant sampling").
+
+        Static shapes: each of the F destination buckets is padded to the
+        full request length L (worst case all ids on one shard — the exact-
+        safe choice; degree-ordered hot rows concentrate on shard 0, and
+        the partial shuffle in reorder_by_degree is what spreads them).
+        Memory/comm is therefore F x L lanes per hop; use psum
+        ``local_gather`` when the group shares one id set.
+
+        ``ids`` may contain invalid lanes as any negative value; their rows
+        return zero.
+        """
+        F = self.num_shards
+        L = ids.shape[0]
+        valid = ids >= 0
+        safe = jnp.where(valid, ids, 0)
+        owner = jnp.clip(safe // self.rows_per_shard, 0, F - 1)
+
+        # stable bucket order: sort my requests by owning shard
+        order = jnp.argsort(owner, stable=True)
+        sorted_ids = safe[order]
+        sorted_owner = owner[order]
+        # position of each sorted lane within its bucket
+        start = jnp.searchsorted(sorted_owner, jnp.arange(F, dtype=owner.dtype))
+        slot = jnp.arange(L, dtype=jnp.int32) - start[sorted_owner]
+        # send buckets (F, L): bucket f holds my requests owned by shard f;
+        # empty lanes carry -1
+        send = jnp.full((F, L), -1, sorted_ids.dtype)
+        send = send.at[sorted_owner, slot].set(sorted_ids, mode="drop")
+
+        # hop 1: bucket f goes to shard f; recv[g] = shard g's requests to me
+        recv = jax.lax.all_to_all(
+            send, self.axis, split_axis=0, concat_axis=0, tiled=False
+        ).reshape(F, L)
+        my = jax.lax.axis_index(self.axis)
+        rvalid = recv >= 0
+        local_idx = jnp.where(rvalid, recv - my * self.rows_per_shard, 0)
+        served = _hot_gather_fn(local_table, self.kernel)(
+            local_idx.reshape(-1)
+        ).reshape(F, L, -1)
+        served = jnp.where(rvalid[:, :, None], served, 0)
+
+        # hop 2: answers return to their requesters
+        back = jax.lax.all_to_all(
+            served, self.axis, split_axis=0, concat_axis=0, tiled=False
+        ).reshape(F, L, -1)
+        # back[f, slot] = row for my sorted request (bucket f, position slot)
+        rows_sorted = back[sorted_owner, slot]
+        rows = jnp.zeros_like(rows_sorted).at[order].set(rows_sorted)
+        return jnp.where(valid[:, None], rows, 0)
 
     def _gather_fn(self, padded_len: int, dtype):
         """Memoized jitted shard_map gather (a fresh wrapper per call would

@@ -15,9 +15,14 @@ NCCL allreduce + CUDA-IPC object sharing, dist_sampling_ogb_products_quiver.py:
   fuse into one XLA executable — there is no per-batch host round-trip at
   all, something the reference's CPU-driven loop cannot do.
 
-Sampling runs redundantly across the ``feature`` axis (same seeds, same
-fold-in key => identical results per replica) — cheaper than broadcasting
-its outputs for the mesh sizes this targets.
+Seed-block placement is selectable (``seed_sharding``): under ``"data"``
+sampling runs redundantly across the ``feature`` axis (same seeds, same
+fold-in key => identical results per replica) and the sharded gather is a
+psum; under ``"all"`` every device is a full data worker over its own seed
+block and the sharded gather routes requests to their owning shard with
+all_to_all (ShardedTensor.routed_gather) — measured, the redundancy of
+"data" costs ~linearly in the feature-axis width, so prefer "all" whenever
+feature > 1 (docs/Introduction.md "Cost of redundant sampling").
 """
 
 from __future__ import annotations
@@ -63,6 +68,7 @@ class DistributedTrainer:
         model,
         tx: optax.GradientTransformation,
         local_batch: int = 128,
+        seed_sharding: str = "data",
     ):
         if feature.cold is not None:
             raise ValueError(
@@ -76,6 +82,23 @@ class DistributedTrainer:
                 "(mode='HBM'); HOST-mode staged gathers are single-device "
                 "for now — use the unfused loop"
             )
+        # seed_sharding: which mesh axes carry seed blocks.
+        #   "data" — the original design: every member of a feature-axis
+        #     group runs the SAME seed block (sampling + model work is
+        #     duplicated feature-size times; the sharded-table gather is a
+        #     cheap psum). Right when feature == 1.
+        #   "all"  — every device is a full data worker over its own seed
+        #     block; the sharded-table gather routes requests to owners
+        #     with all_to_all (ShardedTensor.routed_gather) — the true
+        #     NVLink-clique analogue (each reference GPU runs its own batch
+        #     and loads peer HBM). Measured on the 8-dev CPU mesh the
+        #     redundancy of "data" costs ~linearly in feature size
+        #     (docs/Introduction.md), so prefer "all" whenever feature > 1.
+        self.seed_sharding = str(seed_sharding)
+        if self.seed_sharding not in ("data", "all"):
+            raise ValueError(
+                f"seed_sharding must be 'data' or 'all', got {seed_sharding!r}"
+            )
         self.mesh = mesh
         self.sampler = sampler
         self.feature = feature
@@ -83,7 +106,13 @@ class DistributedTrainer:
         self.tx = tx
         self.local_batch = int(local_batch)
         self.data_size = mesh.shape[DATA_AXIS]
-        self.global_batch = self.local_batch * self.data_size
+        self.feature_size = mesh.shape[FEATURE_AXIS]
+        # seed-block workers: every device under "all", one per data group
+        # under "data"
+        self.workers = self.data_size * (
+            self.feature_size if self.seed_sharding == "all" else 1
+        )
+        self.global_batch = self.local_batch * self.workers
         _, self.caps = sampler._compiled(self.local_batch)
         self._step = self._build()
         self._epoch_fn = self._build_epoch()
@@ -100,12 +129,18 @@ class DistributedTrainer:
         sizes = sampler.sizes
         sharded = isinstance(feature, ShardedFeature)
 
+        routed = self.seed_sharding == "all"
+
         def gather_features(hot_table, n_id):
             valid = n_id >= 0
             ids = jnp.where(valid, n_id, 0)
             if feature.feature_order is not None:
                 ids = feature.feature_order[ids]
-            if sharded:
+            if sharded and routed:
+                # distinct ids per feature-group member: route to owners
+                ids = jnp.where(valid, ids, -1)
+                x = feature.hot.routed_gather(hot_table, ids)
+            elif sharded:
                 part = feature.hot.local_gather(hot_table, ids)
                 x = jax.lax.psum(part, feature.hot.axis)
             else:
@@ -113,9 +148,15 @@ class DistributedTrainer:
             return jnp.where(valid[:, None], x, 0)
 
         def body(params, opt_state, topo, hot_table, seeds, labels, key):
-            # distinct key per data index, shared across the feature axis;
-            # separate streams for sampling vs dropout (use-once discipline)
-            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            # distinct key per seed-block worker; under "data" sharding the
+            # feature-axis members share the key (identical redundant
+            # sampling); separate streams for sampling vs dropout
+            widx = jax.lax.axis_index(DATA_AXIS)
+            if routed:
+                widx = widx * mesh.shape[FEATURE_AXIS] + jax.lax.axis_index(
+                    FEATURE_AXIS
+                )
+            key = jax.random.fold_in(key, widx)
             sample_key, dropout_key = jax.random.split(key)
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
             n_id, _, adjs, _, _, _ = multilayer_sample(
@@ -145,7 +186,7 @@ class DistributedTrainer:
         fn = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), hot_spec, P(DATA_AXIS), P(), P()),
+            in_specs=(P(), P(), P(), hot_spec, self._seed_spec(), P(), P()),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
@@ -178,12 +219,18 @@ class DistributedTrainer:
         opt_state = self.tx.init(params)
         return params, opt_state
 
+    def _seed_spec(self) -> P:
+        if self.seed_sharding == "all":
+            return P((DATA_AXIS, FEATURE_AXIS))
+        return P(DATA_AXIS)
+
     def shard_seeds(self, seeds: np.ndarray):
-        """Pack a global seed array into per-device valid-prefix blocks,
-        padded to (data_size * local_batch,) with -1."""
+        """Pack a global seed array into per-worker valid-prefix blocks,
+        padded to (workers * local_batch,) with -1 (workers = every device
+        under seed_sharding="all", one per data group under "data")."""
         seeds = np.asarray(seeds)
-        blocks = np.array_split(seeds, self.data_size)
-        out = np.full((self.data_size, self.local_batch), -1, np.int32)
+        blocks = np.array_split(seeds, self.workers)
+        out = np.full((self.workers, self.local_batch), -1, np.int32)
         for i, b in enumerate(blocks):
             if len(b) > self.local_batch:
                 raise ValueError(
@@ -197,7 +244,7 @@ class DistributedTrainer:
         full (N,) label array (replicated)."""
         packed = self.shard_seeds(seeds)
         packed = jax.device_put(
-            jnp.asarray(packed), NamedSharding(self.mesh, P(DATA_AXIS))
+            jnp.asarray(packed), NamedSharding(self.mesh, self._seed_spec())
         )
         hot = self._hot()
         return self._step(
@@ -205,10 +252,11 @@ class DistributedTrainer:
         )
 
     def pack_epoch(self, train_idx: np.ndarray, key=None):
-        """Shuffle ``train_idx`` and pack it into a (steps, data*local_batch)
-        seed matrix of per-device valid-prefix blocks (-1 padded) — the xs
-        of :meth:`epoch_scan`. Host-side preprocessing (the DataLoader
-        shuffle of the reference's loop, dist_sampling_ogb_products:109)."""
+        """Shuffle ``train_idx`` and pack it into a (steps,
+        workers*local_batch) seed matrix of per-worker valid-prefix blocks
+        (-1 padded) — the xs of :meth:`epoch_scan`. Host-side preprocessing
+        (the DataLoader shuffle of the reference's loop,
+        dist_sampling_ogb_products:109)."""
         idx = np.asarray(train_idx)
         if key is not None:
             idx = np.random.default_rng(int(key)).permutation(idx)
@@ -253,7 +301,7 @@ class DistributedTrainer:
         hot = self._hot()
         packed = jax.device_put(
             jnp.asarray(seed_mat),
-            NamedSharding(self.mesh, P(None, DATA_AXIS)),
+            NamedSharding(self.mesh, P(None, *self._seed_spec())),
         )
         return self._epoch_fn(
             params, opt_state, self.sampler.topo, hot, packed, labels, key

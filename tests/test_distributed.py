@@ -30,8 +30,11 @@ def _labeled_graph(n=400, classes=4, seed=0):
     return ei, feat, labels
 
 
-@pytest.mark.parametrize("feature_kind", ["replicate", "shard"])
-def test_fused_training_learns(feature_kind):
+@pytest.mark.parametrize(
+    "feature_kind,seed_sharding",
+    [("replicate", "data"), ("shard", "data"), ("shard", "all")],
+)
+def test_fused_training_learns(feature_kind, seed_sharding):
     ei, feat, labels = _labeled_graph()
     topo = CSRTopo(edge_index=ei)
     n = topo.node_count
@@ -44,14 +47,17 @@ def test_fused_training_learns(feature_kind):
 
     model = GraphSAGE(hidden=32, num_classes=4, num_layers=2)
     tx = optax.adam(5e-3)
-    trainer = DistributedTrainer(mesh, sampler, feature, model, tx, local_batch=64)
+    trainer = DistributedTrainer(mesh, sampler, feature, model, tx,
+                                 local_batch=64, seed_sharding=seed_sharding)
+    # "all": every device a worker -> global batch spans 8 blocks
+    assert trainer.global_batch == (512 if seed_sharding == "all" else 256)
     params, opt_state = trainer.init(jax.random.PRNGKey(0))
 
     labels_dev = jnp.asarray(labels[:n].astype(np.int32))
     rng = np.random.default_rng(0)
     losses = []
     for step in range(25):
-        seeds = rng.integers(0, n, 256)  # 4 data shards x 64
+        seeds = rng.integers(0, n, trainer.global_batch)
         params, opt_state, loss = trainer.step(
             params, opt_state, seeds, labels_dev, jax.random.PRNGKey(step)
         )
