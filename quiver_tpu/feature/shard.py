@@ -160,25 +160,40 @@ class ShardedTensor(KernelChoice):
         rows = jnp.zeros_like(rows_sorted).at[order].set(rows_sorted)
         return jnp.where(valid[:, None], rows, 0)
 
-    def _gather_fn(self, padded_len: int, dtype):
+    def _gather_fn(self, padded_len: int, dtype, routed: bool = False):
         """Memoized jitted shard_map gather (a fresh wrapper per call would
-        re-trace on every eager batch)."""
-        cache_key = (padded_len, np.dtype(dtype).name)
+        re-trace on every eager batch).
+
+        ``routed=False``: ids shard over the data axes, remote rows arrive
+        by psum. ``routed=True``: ids shard over EVERY mesh axis and each
+        device routes its own slice to the owning shards (routed_gather),
+        so per-device gather work is 1/num_devices of the request instead
+        of 1/data_size.
+        """
+        cache_key = (padded_len, np.dtype(dtype).name, routed)
         if cache_key in self._gather_cache:
             return self._gather_cache[cache_key]
 
-        data_axes = tuple(a for a in self.mesh.axis_names if a != self.axis)
+        if routed:
+            ids_axes = tuple(self.mesh.axis_names)
 
-        def body(local_table, local_ids):
-            part = self.local_gather(local_table, local_ids)
-            return jax.lax.psum(part, self.axis)
+            def body(local_table, local_ids):
+                return self.routed_gather(local_table, local_ids)
+        else:
+            ids_axes = tuple(
+                a for a in self.mesh.axis_names if a != self.axis
+            )
+
+            def body(local_table, local_ids):
+                part = self.local_gather(local_table, local_ids)
+                return jax.lax.psum(part, self.axis)
 
         f = jax.jit(
             jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(self.axis, None), P(data_axes)),
-                out_specs=P(data_axes, None),
+                in_specs=(P(self.axis, None), P(ids_axes)),
+                out_specs=P(ids_axes, None),
             )
         )
         self._gather_cache[cache_key] = f
@@ -192,20 +207,33 @@ class ShardedTensor(KernelChoice):
         self.table = None
         self._gather_cache.clear()
 
-    def __getitem__(self, ids):
-        """Standalone sharded gather: ids sharded over the data axis,
-        result sharded the same way. For fused use inside a larger
-        shard_map, call ``local_gather`` + psum directly."""
-        data_size = 1
+    def gather(self, ids, routed: bool = False):
+        """Standalone sharded gather.
+
+        ``routed=False``: ids shard over the data axes (replicated across
+        the feature axis); remote rows arrive by psum. ``routed=True``: ids
+        shard over EVERY axis and each device routes its slice to the
+        owning shards (two all_to_alls) — per-device work drops by the
+        feature-axis width; see routed_gather. Same results either way.
+        """
+        mult = 1
         for a in self.mesh.axis_names:
-            if a != self.axis:
-                data_size *= self.mesh.shape[a]
+            if routed or a != self.axis:
+                mult *= self.mesh.shape[a]
         n = ids.shape[0]
-        pad = (-n) % data_size
+        pad = (-n) % mult
         if pad:
-            ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
-        out = self._gather_fn(ids.shape[0], ids.dtype)(self.table, ids)
+            # -1 = the documented invalid-lane sentinel: padded lanes skip
+            # the gather instead of becoming real requests for row 0
+            # (psum-path local_gather treats any non-owned id as zeros, so
+            # -1 is safe there too)
+            ids = jnp.concatenate([ids, jnp.full(pad, -1, ids.dtype)])
+        out = self._gather_fn(ids.shape[0], ids.dtype, routed)(self.table, ids)
         return out[:n] if pad else out
+
+    def __getitem__(self, ids):
+        """Standalone sharded gather (psum flavor); see :meth:`gather`."""
+        return self.gather(ids)
 
 
 class ShardedFeature(KernelChoice):
