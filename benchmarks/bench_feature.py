@@ -37,6 +37,12 @@ def main():
         help="hot-tier gather kernel (auto = pallas on TPU, xla elsewhere)",
     )
     p.add_argument(
+        "--routed", action="store_true",
+        help="shard policy: owner-routed all_to_all hot-tier gather (ids "
+        "sharded over every mesh axis) instead of the psum flavor — the "
+        "seed_sharding='all' trainer's gather",
+    )
+    p.add_argument(
         "--dtype", default="f32", choices=["f32", "bf16", "int8"],
         help="feature storage dtype: bf16 halves row bytes; int8 "
         "(per-row absmax quantization, dequant on gather) quarters them",
@@ -92,9 +98,16 @@ def _body(args):
         for _ in range(min(args.iters, 8))  # reuse id sets; drawing is slow
     ]
 
+    def fetch(ids):
+        if args.routed:
+            if args.policy != "shard":
+                raise ValueError("--routed requires --policy shard")
+            return store.gather(ids, routed=True)
+        return store[ids]
+
     t0 = time.time()
     for i in range(args.warmup):
-        res = store[jnp.asarray(batches[i % len(batches)])]
+        res = fetch(jnp.asarray(batches[i % len(batches)]))
     jax.block_until_ready(res)
     log(f"warmup+compile: {time.time()-t0:.1f}s; hot ratio {store.cache_ratio:.2f}")
 
@@ -106,7 +119,7 @@ def _body(args):
     total_bytes = 0
     t0 = time.time()
     for i in range(args.iters):
-        res = store[jnp.asarray(batches[i % len(batches)])]
+        res = fetch(jnp.asarray(batches[i % len(batches)]))
         total_bytes += res.shape[0] * (
             res.shape[1] * stored_itemsize + row_overhead
         )
@@ -135,6 +148,7 @@ def _body(args):
         cache_ratio=round(store.cache_ratio, 3),
         gather_batch=args.gather_batch,
         dispatch="percall",
+        routed=getattr(args, "routed", False),
     )
 
 
@@ -158,10 +172,12 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
     # ShardedFeature is not (its gather wraps a shard_map program); captured
     # device buffers are hoisted to program parameters either way, so one
     # code path serves both policies
+    routed = getattr(args, "routed", False)
+
     @jax.jit
     def stream(ids_all):
         def step(carry, ids):
-            rows = store[ids]
+            rows = store.gather(ids, routed=True) if routed else store[ids]
             return carry + jnp.sum(rows.astype(jnp.float32)), None
         total, _ = lax.scan(step, jnp.float32(0), ids_all)
         return total
@@ -191,6 +207,7 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
         gather_batch=args.gather_batch,
         dispatch="stream",
         stream_batches=args.stream,
+        routed=getattr(args, "routed", False),
     )
 
 

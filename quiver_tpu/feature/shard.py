@@ -350,7 +350,16 @@ class ShardedFeature(KernelChoice):
 
     def __getitem__(self, n_id):
         """Gather rows for data-axis-sharded (or replicated) node ids."""
-        hot_gather = None if self.hot is None else lambda ids: self.hot[ids]
+        return self.gather(n_id)
+
+    def gather(self, n_id, routed: bool = False):
+        """Tiered gather; ``routed=True`` uses the owner-routed hot-tier
+        flavor (ids sharded over every mesh axis — see
+        ShardedTensor.gather) instead of the psum flavor."""
+        hot_gather = (
+            None if self.hot is None
+            else lambda ids: self.hot.gather(ids, routed=routed)
+        )
         cold_gather = (
             None
             if self.cold is None
@@ -358,8 +367,9 @@ class ShardedFeature(KernelChoice):
                 self.cold, ids, self._cold_is_host, mesh=self.mesh
             )
         )
-        # int8 tiers dequantize after the (psum'd) gather; only one shard
-        # contributes non-zero int8 rows so the reduction is overflow-free
+        # int8 tiers dequantize after the (psum'd or routed) gather; only
+        # one shard contributes non-zero int8 rows so the reduction is
+        # overflow-free
         hot_gather, cold_gather = wrap_dequant_gathers(
             self.scale, self.hot_rows, hot_gather, cold_gather
         )
