@@ -53,6 +53,10 @@ def main():
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--data-axis", type=int, default=None)
     p.add_argument("--feature-axis", type=int, default=1)
+    p.add_argument("--seed-sharding", default="data", choices=["data", "all"],
+                   help="'all': every device a data worker; the sharded "
+                   "gather owner-routes via all_to_all (recommended when "
+                   "feature-axis > 1 — removes the redundant-sampling cost)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -77,7 +81,8 @@ def main():
     model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
                       num_layers=len(args.fanout))
     trainer = DistributedTrainer(mesh, sampler, feature, model,
-                                 optax.adam(1e-3), local_batch=args.local_batch)
+                                 optax.adam(1e-3), local_batch=args.local_batch,
+                                 seed_sharding=args.seed_sharding)
     params, opt_state = trainer.init(jax.random.PRNGKey(args.seed))
 
     # global batch split over the data axis = train_idx.split(world)[rank]
