@@ -99,6 +99,16 @@ class DistributedTrainer:
             raise ValueError(
                 f"seed_sharding must be 'data' or 'all', got {seed_sharding!r}"
             )
+        if self.seed_sharding == "data" and mesh.shape[FEATURE_AXIS] > 1:
+            from ..utils.trace import get_logger
+
+            get_logger().info(
+                "seed_sharding='data' on a feature=%d mesh duplicates "
+                "sampling/model work %dx across the feature group; "
+                "seed_sharding='all' removes that cost (measured ~linear, "
+                "docs/Introduction.md)",
+                mesh.shape[FEATURE_AXIS], mesh.shape[FEATURE_AXIS],
+            )
         self.mesh = mesh
         self.sampler = sampler
         self.feature = feature
