@@ -31,7 +31,8 @@ import subprocess
 import sys
 import time
 
-CHILD = ["-m", "benchmarks.bench_sampler", "--stages", "--stream", "128"]
+CHILD = ["-m", "benchmarks.bench_sampler", "--stages", "--stream", "128",
+         "--dedup", "both"]
 # one real-chip attempt budget: first jit compile alone is 20-40s; the
 # products-scale graph build is ~10s; 50 measured iters a few seconds.
 ATTEMPT_TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1500))

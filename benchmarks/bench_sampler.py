@@ -41,9 +41,13 @@ def main():
     p.add_argument(
         "--dedup",
         default="sort",
-        choices=["sort", "map"],
+        choices=["sort", "map", "both"],
         help="reindex dedup strategy: stable-sort run-scan or the sort-free "
-        "dense-map scatter-min (reference hash-table analogue)",
+        "dense-map scatter-min (reference hash-table analogue). 'both' "
+        "(stream mode) measures the two in one process — sharing the "
+        "device topology and the planned caps — and emits the faster "
+        "stream record FIRST, so the headline self-selects the winning "
+        "strategy on whatever backend it runs on",
     )
     p.add_argument(
         "--caps",
@@ -170,6 +174,7 @@ def _stage_profile(args, sampler, topo, reps: int = 30):
             None,
             layer=l,
             stage="reindex",
+            dedup=sampler.dedup,
             frontier_cap=int(caps[l]),
         )
         cur, cur_n = frontier, n_frontier
@@ -184,27 +189,65 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     reshape/stack assembly is dead code. Timed wall includes the seed
     matrix H2D and the scalar readback. Valid edges only (BASELINE.md
     honesty rule).
+
+    ``--dedup both``: a second sampler measures the dense-map strategy in
+    the same process (sharing the device topology and the already-planned
+    caps); records are emitted fastest-first so the supervisor's
+    first-SEPS-record headline self-selects the winner on this backend.
     """
-    rng = np.random.default_rng(args.seed + 13)
+    from quiver_tpu import GraphSageSampler
+
     cap = sampler._seed_capacity  # _body always sets seed_capacity=batch
-    res = stream_seps(sampler, topo.node_count, cap, args.stream, rng, reps)
-    if res is None:
-        return
-    seps, oflo, stream = res
-    emit(
-        "sampled-edges/sec/chip",
-        seps,
-        "SEPS",
-        BASELINE_UVA_SEPS,
-        mode=args.mode,
-        kernel=args.kernel,
-        fanout=args.fanout,
-        batch=args.batch,
-        caps=args.caps,
-        dedup=args.dedup,
-        dispatch="stream",
-        stream_batches=stream,
-        overflow=oflo,
+
+    candidates = [(sampler.dedup, sampler)]
+    if args.dedup == "both":
+        other = GraphSageSampler(
+            topo, args.fanout, mode=args.mode, seed_capacity=cap,
+            seed=args.seed, kernel=args.kernel, dedup="map",
+            frontier_caps=(
+                tuple(sampler._frontier_caps)
+                if sampler._frontier_caps is not None else None
+            ),
+            device_topo=sampler.topo,
+        )
+        candidates.append(("map", other))
+
+    results = []
+    for dedup, s in candidates:
+        # identical seed stream per candidate (a fresh rng from the same
+        # seed): the winner must be decided by strategy, not draw variance
+        rng = np.random.default_rng(args.seed + 13)
+        try:
+            res = stream_seps(s, topo.node_count, cap, args.stream, rng, reps)
+        except Exception as e:  # noqa: BLE001 — one candidate must not
+            # discard the other's measurement
+            log(f"stream candidate dedup={dedup} failed: "
+                f"{type(e).__name__}: {str(e)[:200]}")
+            continue
+        if res is not None:
+            results.append((res[0], dedup, res))
+    winner = None
+    for seps, dedup, (_, oflo, stream) in sorted(results, reverse=True):
+        emit(
+            "sampled-edges/sec/chip",
+            seps,
+            "SEPS",
+            BASELINE_UVA_SEPS,
+            mode=args.mode,
+            kernel=args.kernel,
+            fanout=args.fanout,
+            batch=args.batch,
+            caps=args.caps,
+            dedup=dedup,
+            dispatch="stream",
+            stream_batches=stream,
+            overflow=oflo,
+        )
+        if winner is None:
+            winner = dedup
+    # the stage profile should attribute the HEADLINE strategy
+    return next(
+        (s for d, s in candidates if d == winner), sampler
     )
 
 
@@ -214,9 +257,10 @@ def _body(args):
     from quiver_tpu import GraphSageSampler
 
     topo = build_graph(args)
+    base_dedup = "sort" if args.dedup == "both" else args.dedup
     sampler = GraphSageSampler(
         topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
-        seed=args.seed, kernel=args.kernel, dedup=args.dedup,
+        seed=args.seed, kernel=args.kernel, dedup=base_dedup,
         frontier_caps="auto" if args.caps == "auto" else None,
     )
     rng = np.random.default_rng(args.seed)
@@ -237,13 +281,17 @@ def _body(args):
     dt = time.time() - t0
     percall_seps = total_edges / dt
 
+    stage_sampler = sampler
+    if args.dedup == "both" and not args.stream:
+        log("WARNING: --dedup both only compares under --stream; this run "
+            "measures dedup=sort per-call only")
     if args.stream:
         # stream headline FIRST (the supervisor takes the first SEPS record
         # as the headline), per-call after as the dispatch=percall record.
         # Guarded: a stream failure must not discard the per-call number
         # already in hand (same discipline as _stage_profile below)
         try:
-            _stream_seps(args, sampler, topo)
+            stage_sampler = _stream_seps(args, sampler, topo) or sampler
         except Exception as e:  # noqa: BLE001
             log(f"stream measure failed (per-call record stands): "
                 f"{type(e).__name__}: {str(e)[:200]}")
@@ -258,7 +306,7 @@ def _body(args):
         fanout=args.fanout,
         batch=args.batch,
         caps=args.caps,
-        dedup=args.dedup,
+        dedup=base_dedup,
         dispatch="percall",
     )
 
@@ -267,7 +315,7 @@ def _body(args):
         # not take the run down (each stage is a fresh compile, each a
         # fresh chance at a transient backend error)
         try:
-            _stage_profile(args, sampler, topo)
+            _stage_profile(args, stage_sampler, topo)
         except Exception as e:  # noqa: BLE001
             log(f"stage profile failed (headline unaffected): "
                 f"{type(e).__name__}: {str(e)[:200]}")
