@@ -217,3 +217,26 @@ def test_scoreboard_timeout_keeps_partial_records(monkeypatch):
     recs, err, _ = scoreboard.run_job("mod", [], smoke=False, timeout_s=5)
     assert recs == [{"metric": "sampled-edges/sec/chip", "value": 3}]
     assert str(err).startswith("timeout")
+
+
+def test_dedup_both_emits_fastest_stream_first():
+    """--dedup both must emit its stream records fastest-first (the
+    supervisor headlines the FIRST SEPS record), with both strategies
+    present and the per-call record last."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.bench_sampler", "--smoke",
+         "--stream", "2", "--dedup", "both"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    recs = [json.loads(l) for l in r.stdout.splitlines()
+            if l.strip().startswith("{")]
+    streams = [x for x in recs if x.get("dispatch") == "stream"]
+    assert len(streams) == 2, r.stdout + r.stderr[-500:]
+    assert {x["dedup"] for x in streams} == {"sort", "map"}
+    assert streams[0]["value"] >= streams[1]["value"]
+    assert recs[-1]["dispatch"] == "percall"
