@@ -67,7 +67,7 @@ JOBS = [
      "PyG-all-on-GPU 23.3s (Introduction_en.md:153-158)"),
     ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"],
      "beyond-HBM topology placement"),
-    ("rgcn", "benchmarks.bench_rgcn", [],
+    ("rgcn", "benchmarks.bench_rgcn", ["--stream", "16"],
      "no reference baseline (hetero is beyond-parity)"),
     ("infer-layerwise", "benchmarks.bench_infer", [],
      "full-graph layer-wise inference (reference never benchmarked it)"),
