@@ -173,3 +173,31 @@ def test_sharded_feature_routed_matches_psum():
     b = np.asarray(store.gather(jnp.asarray(ids), routed=True))
     assert np.array_equal(a, feat[ids])
     assert np.array_equal(b, feat[ids])
+
+
+def test_sharded_feature_int8_routed_dequant():
+    """int8 quantized rows through the routed gather must dequantize the
+    same as through the psum gather (scale indexing uses original ids)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.feature.shard import ShardedFeature
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(8)
+    ei = np.stack([rng.integers(0, 300, 2000), rng.integers(0, 300, 2000)])
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    feat = rng.normal(size=(n, 16)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(mesh, device_cache_size="1G", csr_topo=topo,
+                           dtype="int8").from_cpu_tensor(feat)
+    ids = rng.integers(0, n, 64).astype(np.int32)
+    a = np.asarray(store[jnp.asarray(ids)])
+    b = np.asarray(store.gather(jnp.asarray(ids), routed=True))
+    assert np.array_equal(a, b)
+    # dequant error bounded by absmax/254 per row
+    err = np.abs(a - feat[ids]).max(axis=1)
+    bound = np.abs(feat[ids]).max(axis=1) / 254 + 1e-7
+    assert np.all(err <= bound)
