@@ -385,6 +385,14 @@ class GraphSageSampler:
                 observed = [int(c) for c in frontier_counts[::-1]]
                 before = self._frontier_caps
                 self._plan_auto(cap, observed)
+                if self._frontier_caps != before:
+                    from ..utils.trace import get_logger
+
+                    get_logger().info(
+                        "auto caps %s: %s -> %s (recompile)",
+                        "planned" if before is None else "regrown",
+                        before, self._frontier_caps,
+                    )
                 if not first_plan and self._frontier_caps == before:
                     # saturated: caps already at worst case and still
                     # overflowing — rerunning the identical program cannot
