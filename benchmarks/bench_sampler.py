@@ -50,6 +50,13 @@ def main():
         "strategy on whatever backend it runs on",
     )
     p.add_argument(
+        "--weighted", action="store_true",
+        help="weight-proportional neighbor draws (inverse-CDF over per-row "
+        "prefix weights) on exp(N(0,1)) synthetic edge weights — the path "
+        "the reference plumbed but never shipped reachable "
+        "(quiver.cu.hpp:240-272 commented out)",
+    )
+    p.add_argument(
         "--caps",
         default="auto",
         choices=["auto", "worst"],
@@ -204,6 +211,7 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
         other = GraphSageSampler(
             topo, args.fanout, mode=args.mode, seed_capacity=cap,
             seed=args.seed, kernel=args.kernel, dedup="map",
+            weighted=sampler.weighted,
             frontier_caps=(
                 tuple(sampler._frontier_caps)
                 if sampler._frontier_caps is not None else None
@@ -239,6 +247,7 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
             batch=args.batch,
             caps=args.caps,
             dedup=dedup,
+            weighted=getattr(args, "weighted", False),
             dispatch="stream",
             stream_batches=stream,
             overflow=oflo,
@@ -257,10 +266,18 @@ def _body(args):
     from quiver_tpu import GraphSageSampler
 
     topo = build_graph(args)
+    if args.weighted:
+        if args.kernel == "pallas":
+            raise SystemExit("--weighted supports the xla kernel only")
+        w = np.exp(
+            np.random.default_rng(args.seed + 5).normal(size=topo.edge_count)
+        ).astype(np.float32)
+        topo.set_edge_weight(w)
     base_dedup = "sort" if args.dedup == "both" else args.dedup
     sampler = GraphSageSampler(
         topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
         seed=args.seed, kernel=args.kernel, dedup=base_dedup,
+        weighted=args.weighted,
         frontier_caps="auto" if args.caps == "auto" else None,
     )
     rng = np.random.default_rng(args.seed)
@@ -307,6 +324,7 @@ def _body(args):
         batch=args.batch,
         caps=args.caps,
         dedup=base_dedup,
+        weighted=args.weighted,
         dispatch="percall",
     )
 

@@ -47,6 +47,10 @@ JOBS = [
     ("sampler-pallas", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
      "windowed Pallas kernel vs the XLA row above"),
+    ("sampler-weighted", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--weighted", "--stream", "128", "--dedup", "both"],
+     "weight-proportional draws — the path the reference never shipped "
+     "reachable (quiver.cu.hpp:240-272)"),
     ("feature-replicate-xla", "benchmarks.bench_feature",
      ["--policy", "replicate", "--kernel", "xla", "--stream", "32"],
      "XLA-gather control for the kernel=auto row"),
