@@ -59,10 +59,10 @@ def main():
         "--fused", action="store_true",
         help="ONE XLA program per step (DistributedTrainer on the device "
         "mesh): sample + gather + fwd/bwd + update with zero host "
-        "round-trips. Requires the feature table fully HBM-resident, so "
-        "this forces cache-ratio 1.0 — compare against the reference's "
-        "'PyG with full feature on GPU' rows (Introduction_en.md:153-158) "
-        "as well as its headline",
+        "round-trips. Works at any --cache-ratio and --mode: cold-tier "
+        "rows and HOST topologies stage through host compute inside the "
+        "same program. At --cache-ratio 1.0 compare the reference's 'PyG "
+        "with full feature on GPU' rows (Introduction_en.md:153-158)",
     )
     p.add_argument(
         "--scan-epoch", action="store_true",
@@ -70,7 +70,7 @@ def main():
         "over packed seed blocks, params in carry, one loss readback). "
         "Measures real epoch wall time directly instead of extrapolating "
         "iteration time — the TPU-native epoch loop. Implies --fused "
-        "placement rules (full-HBM feature table)",
+        "(and like it, accepts cold tiers and HOST topologies)",
     )
     p.add_argument(
         "--seed-sharding", default="data", choices=["data", "all"],
@@ -111,16 +111,20 @@ def _body(args):
     feat = feat.astype(np.float32)
     if args.scan_epoch:
         args.fused = True
-    if args.fused and args.cache_ratio < 1.0:
-        log("fused mode requires a fully HBM-resident table; "
-            "forcing cache-ratio 1.0")
-        args.cache_ratio = 1.0
-    budget = int(args.cache_ratio * n) * args.feature_dim * 4
+    # fused/scan modes accept cold tiers and HOST topologies since r4: the
+    # staged host gathers compose into the shard_map program
+    itemsize = 2 if args.bf16 else 4  # budget in STORAGE bytes, so the
+    # requested cache-ratio holds regardless of dtype tier
+    budget = int(args.cache_ratio * n) * args.feature_dim * itemsize
     feature = Feature(
         device_cache_size=budget, csr_topo=topo,
         dtype="bfloat16" if args.bf16 else None,
     ).from_cpu_tensor(feat)
     del feat
+    if abs(feature.cache_ratio - args.cache_ratio) > 0.01:
+        log(f"actual hot ratio {feature.cache_ratio:.3f} "
+            f"(requested {args.cache_ratio})")
+    args.cache_ratio = round(feature.cache_ratio, 3)  # records report ACTUAL
     labels_all = jnp.asarray(
         np.random.default_rng(1).integers(0, args.classes, n).astype(np.int32)
     )
@@ -236,7 +240,7 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
     # planned from a local-batch draw — planning at the global batch would
     # leave every device running frontiers ~worker-count too wide
     sampler = GraphSageSampler(
-        topo, args.fanout, mode="HBM", seed_capacity=local_batch,
+        topo, args.fanout, mode=args.mode, seed_capacity=local_batch,
         seed=args.seed, frontier_caps="auto",
     )
     sampler.sample(rng.integers(0, n, local_batch))
@@ -286,7 +290,7 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
     )
     local_batch = -(-args.batch // workers)
     sampler = GraphSageSampler(
-        topo, args.fanout, mode="HBM", seed_capacity=local_batch,
+        topo, args.fanout, mode=args.mode, seed_capacity=local_batch,
         seed=args.seed, frontier_caps="auto",
     )
     sampler.sample(rng.integers(0, n, local_batch))
@@ -329,6 +333,7 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
         batch=args.batch,
         model=args.model,
         mode="FUSED-SCAN",
+        topo_mode=args.mode,
         seed_sharding=args.seed_sharding,
         bf16=bool(args.bf16),
         cache_ratio=args.cache_ratio,

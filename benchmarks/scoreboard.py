@@ -38,7 +38,8 @@ JOBS = [
     ("feature-replicate", "benchmarks.bench_feature",
      ["--policy", "replicate", "--stream", "32"],
      "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),
-    ("epoch-scan", "benchmarks.bench_epoch", ["--scan-epoch", "--bf16"],
+    ("epoch-scan", "benchmarks.bench_epoch",
+     ["--scan-epoch", "--bf16", "--cache-ratio", "1.0"],
      "whole epoch as ONE compiled program, bf16 — the TPU-native epoch "
      "loop, measured directly (vs ref 11.1 s, Introduction_en.md:146-149)"),
     ("sampler-host", "benchmarks.bench_sampler",
@@ -60,17 +61,23 @@ JOBS = [
     ("feature-int8", "benchmarks.bench_feature",
      ["--policy", "replicate", "--dtype", "int8", "--stream", "32"],
      "int8 quantized rows (absmax/row): ~4x cache rows per budget"),
-    ("epoch-fused-bf16", "benchmarks.bench_epoch", ["--fused", "--bf16"],
+    ("epoch-fused-bf16", "benchmarks.bench_epoch",
+     ["--fused", "--bf16", "--cache-ratio", "1.0"],
      "fused + mixed precision: the framework's best-case per-step config"),
     ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"],
      "ref 11.1 s/epoch (1 GPU, Introduction_en.md:146-149)"),
     ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
      "mixed-precision (bf16 MXU matmuls + bf16 feature rows) vs the f32 row"),
-    ("epoch-fused", "benchmarks.bench_epoch", ["--fused"],
+    ("epoch-fused", "benchmarks.bench_epoch",
+     ["--fused", "--cache-ratio", "1.0"],
      "ONE XLA program per step, full-HBM table — vs ref 11.1s AND its "
      "PyG-all-on-GPU 23.3s (Introduction_en.md:153-158)"),
     ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"],
-     "beyond-HBM topology placement"),
+     "beyond-HBM topology placement (unfused per-batch loop)"),
+    ("epoch-scan-host", "benchmarks.bench_epoch",
+     ["--scan-epoch", "--bf16", "--mode", "HOST", "--cache-ratio", "0.5"],
+     "beyond-HBM FUSED: HOST topology + 50% cold tier through one "
+     "compiled epoch program (r4; ref papers100M UVA path equivalent)"),
     ("rgcn", "benchmarks.bench_rgcn", ["--stream", "16"],
      "no reference baseline (hetero is beyond-parity)"),
     ("infer-layerwise", "benchmarks.bench_infer", [],
@@ -79,6 +86,12 @@ JOBS = [
      "no reference baseline (SAINT never landed there)"),
     ("validation", "benchmarks.tpu_validation", [],
      "compiled-Pallas validity + head-to-heads"),
+    # last: single-chip mesh makes routed trivial on TPU; the 8-virtual-
+    # device CPU floor (scripts/cpu_floor.sh) is the multi-device evidence
+    ("feature-shard-routed", "benchmarks.bench_feature",
+     ["--policy", "shard", "--routed", "--stream", "32"],
+     "owner-routed all_to_all hot gather over the mesh feature axis "
+     "(seed_sharding='all' trainer gather), dispatch-clean stream mode"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))

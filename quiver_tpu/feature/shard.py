@@ -363,9 +363,7 @@ class ShardedFeature(KernelChoice):
         cold_gather = (
             None
             if self.cold is None
-            else lambda ids: staged_gather(
-                self.cold, ids, self._cold_is_host, mesh=self.mesh
-            )
+            else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
         )
         # int8 tiers dequantize after the (psum'd or routed) gather; only
         # one shard contributes non-zero int8 rows so the reduction is

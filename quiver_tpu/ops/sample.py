@@ -99,22 +99,19 @@ def _cdf_search(cum_weights, u, base, deg, iters: int):
 def _cdf_search_host(cum_weights, u, base, deg, iters: int):
     """_cdf_search staged as host compute (HOST mode keeps the prefix array
     in pinned host memory; only the small u/base/deg blocks transit — the
-    same explicit host/device placement dance as _staged_gather)."""
+    same memory-space dance as _staged_gather)."""
     from jax.experimental.compute_on import compute_on
-    from jax.sharding import SingleDeviceSharding
+    from jax.memory import Space
 
-    dev = jax.devices()[0]
-    host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
-    dev_s = SingleDeviceSharding(dev, memory_kind="device")
-    u_h = jax.device_put(u, host_s)
-    base_h = jax.device_put(base, host_s)
-    deg_h = jax.device_put(deg, host_s)
+    u_h = jax.device_put(u, Space.Host)
+    base_h = jax.device_put(base, Space.Host)
+    deg_h = jax.device_put(deg, Space.Host)
 
     @compute_on("device_host")
     def search(cw, uu, bb, dd):
         return _cdf_search(cw, uu, bb, dd, iters)
 
-    return jax.device_put(search(cum_weights, u_h, base_h, deg_h), dev_s)
+    return jax.device_put(search(cum_weights, u_h, base_h, deg_h), Space.Device)
 
 
 
@@ -242,7 +239,7 @@ def staged_host_call(fn, static_argnums=()):
     return call
 
 
-def staged_gather(table, idx, host: bool, mesh=None):
+def staged_gather(table, idx, host: bool):
     """Gather rows of ``table``, staging through host memory when ``host``.
 
     The reference's UVA mode lets the sampling kernel dereference pinned host
@@ -250,39 +247,32 @@ def staged_gather(table, idx, host: bool, mesh=None):
     that, so the HOST-mode equivalent is a *staged* gather: the (small) index
     block hops to host memory, the gather runs as host compute against the
     host-resident table, and only the result returns to HBM — the large
-    table itself never transits. With ``mesh``, shardings are mesh-wide
-    (replicated) so results compose with mesh-sharded arrays.
+    table itself never transits. Transfers are memory-SPACE moves
+    (``jax.memory.Space``), sharding-preserving, so the same code composes
+    at the jit level, under vmap/scan, and inside ``shard_map`` bodies (the
+    fused beyond-HBM trainer) — a concrete-sharding ``device_put`` would be
+    ill-formed in per-device SPMD code.
     """
     if not host:
         return table[idx]
-    return _staged_gather_call(table, idx, mesh)
+    return _staged_gather_call(table, idx)
 
 
-def _staged_gather(table, idx, mesh=None):
+def _staged_gather(table, idx):
     from jax.experimental.compute_on import compute_on
+    from jax.memory import Space
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        host_s = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
-        dev_s = NamedSharding(mesh, PartitionSpec(), memory_kind="device")
-    else:
-        from jax.sharding import SingleDeviceSharding
-
-        dev = jax.devices()[0]
-        host_s = SingleDeviceSharding(dev, memory_kind="pinned_host")
-        dev_s = SingleDeviceSharding(dev, memory_kind="device")
-    idx_h = jax.device_put(idx, host_s)
+    idx_h = jax.device_put(idx, Space.Host)
 
     @compute_on("device_host")
     def host_gather(t, i):
         return t[i]
 
     out_h = host_gather(table, idx_h)
-    return jax.device_put(out_h, dev_s)
+    return jax.device_put(out_h, Space.Device)
 
 
 # module-level wrappers so repeated eager calls hit the jit dispatch fastpath
-# (Mesh and iters are hashable, so they ride as static args)
-_staged_gather_call = staged_host_call(_staged_gather, static_argnums=(2,))
+# (iters is hashable, so it rides as a static arg)
+_staged_gather_call = staged_host_call(_staged_gather)
 _cdf_search_host_call = staged_host_call(_cdf_search_host, static_argnums=(4,))

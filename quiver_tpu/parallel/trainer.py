@@ -53,8 +53,8 @@ class DistributedTrainer:
       mesh: (data, feature) mesh from parallel.mesh.make_mesh.
       sampler: GraphSageSampler (its topology is replicated to all devices).
       feature: Feature (device_replicate) or ShardedFeature (mesh_shard).
-        The fused path requires the table fully device-resident; cold-tier
-        configurations train via the unfused loop (sample -> feature -> step).
+        Cold tiers are fused too: pinned-host rows ride as mesh-replicated
+        operands and their staged gathers compose into the step program.
       model: flax module with (x, adjs, train=...) signature.
       tx: optax optimizer.
       local_batch: per-device seed-block size (padded).
@@ -70,18 +70,12 @@ class DistributedTrainer:
         local_batch: int = 128,
         seed_sharding: str = "data",
     ):
-        if feature.cold is not None:
-            raise ValueError(
-                "fused SPMD training requires a fully device-resident feature "
-                "table (cache covers all rows); use the unfused loop for "
-                "cold-tier configs"
-            )
-        if getattr(sampler.topo, "host_indices", False):
-            raise ValueError(
-                "fused SPMD training requires an HBM-resident topology "
-                "(mode='HBM'); HOST-mode staged gathers are single-device "
-                "for now — use the unfused loop"
-            )
+        # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
+        # feature rows ride as mesh-replicated pinned-host operands, and the
+        # staged host gathers (ops/sample.staged_gather — memory-SPACE
+        # transfers, shard_map-safe) compose into the same one-program step
+        # (reference equivalent: UVA training is its main papers100M path,
+        # dist_sampling_ogb_paper100M_quiver.py:120-165).
         # seed_sharding: which mesh axes carry seed blocks.
         #   "data" — the original design: every member of a feature-axis
         #     group runs the SAME seed block (sampling + model work is
@@ -115,6 +109,9 @@ class DistributedTrainer:
         self.model = model
         self.tx = tx
         self.local_batch = int(local_batch)
+        self.topo = self._mesh_wide_topo(sampler.topo)
+        self._cold = self._mesh_wide_host(feature.cold) if getattr(
+            feature, "_cold_is_host", False) else feature.cold
         self.data_size = mesh.shape[DATA_AXIS]
         self.feature_size = mesh.shape[FEATURE_AXIS]
         # seed-block workers: every device under "all", one per data group
@@ -129,6 +126,45 @@ class DistributedTrainer:
 
     # -- program ------------------------------------------------------------
 
+    def _mesh_wide_host(self, arr):
+        """Replicate a single-device pinned-host array across the mesh's
+        host space (one addressable copy per device; same-host devices share
+        RAM). Required because shard_map operands must match the mesh."""
+        if arr is None:
+            return None
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, P(), memory_kind="pinned_host")
+        )
+
+    def _mesh_wide_topo(self, topo):
+        """HOST-mode topologies arrive single-device-placed; re-anchor their
+        pinned-host arrays mesh-wide so the fused program can stage gathers
+        on every device. HBM topologies pass through (jit auto-replicates
+        plain device arrays)."""
+        if not getattr(topo, "host_indices", False):
+            return topo
+        from ..core.topology import DeviceTopology
+
+        return DeviceTopology(
+            topo.indptr,
+            self._mesh_wide_host(topo.indices),
+            self._mesh_wide_host(topo.eid),
+            self._mesh_wide_host(topo.cum_weights),
+            host_indices=True,
+            search_iters=topo.search_iters,
+        )
+
+    def _feature_parts(self):
+        """The feature-store arrays handed to the shard_map program:
+        (hot, cold, feature_order, scale)."""
+        hot = (
+            self.feature.hot.table
+            if isinstance(self.feature, ShardedFeature)
+            else self.feature.hot
+        )
+        return (hot, self._cold, self.feature.feature_order,
+                self.feature.scale)
+
     def _build(self):
         mesh = self.mesh
         sampler = self.sampler
@@ -138,26 +174,35 @@ class DistributedTrainer:
         caps = self.caps
         sizes = sampler.sizes
         sharded = isinstance(feature, ShardedFeature)
+        cold_is_host = getattr(feature, "_cold_is_host", False)
+        hot_rows = feature.hot_rows
 
         routed = self.seed_sharding == "all"
 
-        def gather_features(hot_table, n_id):
-            valid = n_id >= 0
-            ids = jnp.where(valid, n_id, 0)
-            if feature.feature_order is not None:
-                ids = feature.feature_order[ids]
-            if sharded and routed:
-                # distinct ids per feature-group member: route to owners
-                ids = jnp.where(valid, ids, -1)
-                x = feature.hot.routed_gather(hot_table, ids)
-            elif sharded:
-                part = feature.hot.local_gather(hot_table, ids)
-                x = jax.lax.psum(part, feature.hot.axis)
-            else:
-                x = hot_table[ids]
-            return jnp.where(valid[:, None], x, 0)
+        def gather_features(parts, n_id):
+            from ..feature.feature import tiered_lookup, wrap_dequant_gathers
+            from ..ops.sample import staged_gather
 
-        def body(params, opt_state, topo, hot_table, seeds, labels, key):
+            hot_table, cold_table, order, scale = parts
+            if hot_table is None:
+                hot_g = None
+            elif sharded and routed:
+                # distinct ids per feature-group member: route to owners
+                hot_g = lambda ids: feature.hot.routed_gather(hot_table, ids)
+            elif sharded:
+                hot_g = lambda ids: jax.lax.psum(
+                    feature.hot.local_gather(hot_table, ids), feature.hot.axis
+                )
+            else:
+                hot_g = lambda ids: hot_table[ids]
+            cold_g = (
+                None if cold_table is None
+                else lambda ids: staged_gather(cold_table, ids, cold_is_host)
+            )
+            hot_g, cold_g = wrap_dequant_gathers(scale, hot_rows, hot_g, cold_g)
+            return tiered_lookup(n_id, order, hot_rows, hot_g, cold_g)
+
+        def body(params, opt_state, topo, parts, seeds, labels, key):
             # distinct key per seed-block worker; under "data" sharding the
             # feature-axis members share the key (identical redundant
             # sampling); separate streams for sampling vs dropout
@@ -174,7 +219,7 @@ class DistributedTrainer:
                 weighted=sampler.weighted, kernel=sampler.kernel,
                 dedup=sampler.dedup,
             )
-            x = gather_features(hot_table, n_id)
+            x = gather_features(parts, n_id)
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
             mask = jnp.arange(seeds.shape[0]) < num_seeds
 
@@ -193,23 +238,15 @@ class DistributedTrainer:
             return params, opt_state, loss
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
+        parts_spec = (hot_spec, P(), P(), P())
         fn = jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), hot_spec, self._seed_spec(), P(), P()),
+            in_specs=(P(), P(), P(), parts_spec, self._seed_spec(), P(), P()),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
         return jax.jit(fn)
-
-
-    def _hot(self):
-        """The raw hot-tier table handed to the shard_map program."""
-        return (
-            self.feature.hot.table
-            if isinstance(self.feature, ShardedFeature)
-            else self.feature.hot
-        )
 
     # -- API ----------------------------------------------------------------
 
@@ -223,8 +260,12 @@ class DistributedTrainer:
         _, _, adjs, _, _, _ = run(
             self.sampler.topo, jnp.asarray(padded), jnp.int32(m), jax.random.PRNGKey(0)
         )
-        hot = self._hot()
-        x = jnp.zeros((caps[-1], self.feature.shape[1]), hot.dtype)
+        # the model sees what the tiered gather returns: dequantized f32 for
+        # int8 storage, else the stored dtype (bf16/f32)
+        dtype = (
+            jnp.float32 if self.feature.scale is not None else self.feature.dtype
+        )
+        x = jnp.zeros((caps[-1], self.feature.shape[1]), dtype)
         params = self.model.init({"params": rng}, x, adjs)["params"]
         opt_state = self.tx.init(params)
         return params, opt_state
@@ -256,20 +297,29 @@ class DistributedTrainer:
         packed = jax.device_put(
             jnp.asarray(packed), NamedSharding(self.mesh, self._seed_spec())
         )
-        hot = self._hot()
         return self._step(
-            params, opt_state, self.sampler.topo, hot, packed, labels, key
+            params, opt_state, self.topo, self._feature_parts(), packed,
+            labels, key
         )
 
-    def pack_epoch(self, train_idx: np.ndarray, key=None):
+    def pack_epoch(self, train_idx: np.ndarray, seed=None, key=None):
         """Shuffle ``train_idx`` and pack it into a (steps,
         workers*local_batch) seed matrix of per-worker valid-prefix blocks
         (-1 padded) — the xs of :meth:`epoch_scan`. Host-side preprocessing
         (the DataLoader shuffle of the reference's loop,
         dist_sampling_ogb_products:109)."""
+        if seed is None:
+            seed = key  # legacy name
         idx = np.asarray(train_idx)
-        if key is not None:
-            idx = np.random.default_rng(int(key)).permutation(idx)
+        if seed is not None:
+            # accept an int seed or a jax PRNGKey (typed or uint32 pair);
+            # int() of a shape-(2,) key array would raise
+            if hasattr(seed, "dtype") and jnp.issubdtype(
+                    seed.dtype, jax.dtypes.prng_key):
+                seed = jax.random.key_data(seed)
+            if getattr(seed, "shape", ()) != ():
+                seed = int(np.asarray(seed).ravel()[-1])
+            idx = np.random.default_rng(int(seed)).permutation(idx)
         steps = -(-len(idx) // self.global_batch)
         return np.stack([
             self.shard_seeds(idx[s * self.global_batch: (s + 1) * self.global_batch])
@@ -280,13 +330,13 @@ class DistributedTrainer:
         step = self._step  # jitted shard_map; inlines under the outer jit
 
         @jax.jit
-        def fn(params, opt_state, topo, hot, seed_mat, labels, key0):
+        def fn(params, opt_state, topo, parts, seed_mat, labels, key0):
             keys = jax.random.split(key0, seed_mat.shape[0])
 
             def body(carry, xs):
                 p, o = carry
                 seeds, k = xs
-                p, o, loss = step(p, o, topo, hot, seeds, labels, k)
+                p, o, loss = step(p, o, topo, parts, seeds, labels, k)
                 return (p, o), loss
 
             (p, o), losses = jax.lax.scan(
@@ -308,22 +358,24 @@ class DistributedTrainer:
 
         Returns (params, opt_state, losses[steps]).
         """
-        hot = self._hot()
         packed = jax.device_put(
             jnp.asarray(seed_mat),
             NamedSharding(self.mesh, P(None, *self._seed_spec())),
         )
         return self._epoch_fn(
-            params, opt_state, self.sampler.topo, hot, packed, labels, key
+            params, opt_state, self.topo, self._feature_parts(), packed,
+            labels, key
         )
 
 
 class DataParallelTrainer:
-    """Multi-chip training for beyond-HBM configurations — the papers100M path.
+    """Unfused multi-chip training — the reference-shaped papers100M loop.
 
-    The fused :class:`DistributedTrainer` requires everything device-resident;
-    this trainer is its *unfused* sibling for HOST-mode topologies and
-    cold-tier features, mirroring the reference's flagship scale architecture
+    Since r4 the fused :class:`DistributedTrainer` handles beyond-HBM
+    configs too (staged host gathers compose into its one-program step);
+    this trainer remains as the *unfused* alternative — host-driven
+    sample/gather with prefetch overlap — mirroring the reference's
+    flagship scale architecture
     exactly (benchmarks/ogbn-papers100M/dist_sampling_ogb_paper100M_quiver.py:
     120-165): each data-parallel worker samples its own seed block and
     gathers its own features (here: the single-controller sample/gather

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Regenerate the CPU-floor scoreboard rows (docs/cpu_floor/) — the
+# dispatch-clean lower-bound evidence used when the chip is unreachable.
+#
+# The floor is NOT a TPU claim: every row lands platform=cpu. Its role is
+# (a) proving each measurement path end-to-end at full products scale so a
+# chip window is spent measuring, not debugging, and (b) ranking config
+# alternatives (dedup map-vs-sort, dtype tiers, routed-vs-psum) on
+# dispatch-clean stream/scan modes. Multi-device rows (shard/routed) run on
+# the 8-virtual-device CPU mesh.
+#
+# Usage: bash scripts/cpu_floor.sh [job ...]   (default: the feature set)
+set -u
+cd "$(dirname "$0")/.."
+JOBS=("$@")
+if [ ${#JOBS[@]} -eq 0 ]; then
+  JOBS=(feature-replicate feature-replicate-xla feature-bf16 feature-int8
+        feature-shard-routed)
+fi
+JAX_PLATFORMS=cpu \
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+QUIVER_BENCH_TIMEOUT="${QUIVER_BENCH_TIMEOUT:-2400}" \
+python -m benchmarks.scoreboard --only "${JOBS[@]}" --out docs/cpu_floor

@@ -65,15 +65,101 @@ def test_fused_training_learns(feature_kind, seed_sharding):
     assert losses[-1] < losses[0] * 0.75, losses
 
 
-def test_fused_rejects_cold_tier():
-    ei, feat, labels = _labeled_graph(n=100)
+def test_fused_beyond_hbm_epoch_scan_learns():
+    """The papers100M-class config — HOST-mode topology AND a cold-tier
+    feature table — trains through ONE compiled epoch program (epoch_scan),
+    staged host gathers composed inside the shard_map step (VERDICT r3
+    task 6; reference equivalent: UVA training,
+    dist_sampling_ogb_paper100M_quiver.py:120-165)."""
+    ei, feat, labels = _labeled_graph()
     topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
     mesh = make_mesh(data=4, feature=2)
-    sampler = GraphSageSampler(topo, [3], seed=0)
-    feature = Feature(device_cache_size=10 * 16).from_cpu_tensor(feat[: topo.node_count])
-    model = GraphSAGE(hidden=8, num_classes=4, num_layers=1)
-    with pytest.raises(ValueError, match="device-resident"):
-        DistributedTrainer(mesh, sampler, feature, model, optax.sgd(0.1))
+    sampler = GraphSageSampler(topo, [5, 5], seed=3, mode="HOST")
+    # budget covers ~half the rows: real hot AND cold traffic every batch
+    feature = Feature(
+        device_cache_size=(n // 2) * feat.shape[1] * 4, csr_topo=topo
+    ).from_cpu_tensor(feat[:n])
+    assert feature.cold is not None and 0.3 < feature.cache_ratio < 0.7
+    assert sampler.topo.host_indices or not jax.devices()[0].platform == "tpu"
+
+    model = GraphSAGE(hidden=32, num_classes=4, num_layers=2)
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, optax.adam(5e-3), local_batch=64
+    )
+    params, opt_state = trainer.init(jax.random.PRNGKey(0))
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+
+    train_idx = np.random.default_rng(0).integers(
+        0, n, 8 * trainer.global_batch)
+    seed_mat = trainer.pack_epoch(train_idx, seed=7)
+    params, opt_state, losses = trainer.epoch_scan(
+        params, opt_state, seed_mat, labels_dev, jax.random.PRNGKey(42)
+    )
+    losses = np.asarray(losses)
+    assert losses.shape == (8,)
+    assert losses[-1] < losses[0] * 0.75, losses
+
+
+def test_fused_cold_tier_matches_full_hbm():
+    """Tiering must not change math: a cold-tier fused step returns the
+    same loss trajectory as the all-HBM step on identical seeds/keys."""
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    results = []
+    for budget in ("1G", (n // 2) * feat.shape[1] * 4):
+        sampler = GraphSageSampler(topo, [5, 5], seed=3)
+        feature = Feature(device_cache_size=budget).from_cpu_tensor(feat[:n])
+        trainer = DistributedTrainer(
+            mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32
+        )
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        losses = []
+        for step in range(3):
+            seeds = rng.integers(0, n, trainer.global_batch)
+            params, opt_state, loss = trainer.step(
+                params, opt_state, seeds, labels_dev, jax.random.PRNGKey(step)
+            )
+            losses.append(float(loss))
+        results.append(losses)
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
+
+
+def test_fused_int8_feature_dequantizes():
+    """ADVICE r3: the fused gather must dequantize int8 storage (scale is
+    applied inside the shard_map program), not train on raw codes. With
+    absmax/row quantization the first-step loss must track the f32 run
+    closely; raw int8 codes (~127x scale) would blow it apart."""
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    first_losses = {}
+    for dtype in (None, "int8"):
+        sampler = GraphSageSampler(topo, [5, 5], seed=3)
+        feature = ShardedFeature(
+            mesh, device_cache_size="1G", dtype=dtype
+        ).from_cpu_tensor(feat[:n])
+        trainer = DistributedTrainer(
+            mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32,
+            seed_sharding="all",
+        )
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        seeds = np.random.default_rng(0).integers(0, n, trainer.global_batch)
+        _, _, loss = trainer.step(
+            params, opt_state, seeds, labels_dev, jax.random.PRNGKey(1)
+        )
+        first_losses[dtype] = float(loss)
+    assert abs(first_losses["int8"] - first_losses[None]) < 0.05 * abs(
+        first_losses[None]
+    ), first_losses
 
 
 def test_shard_seeds_packing():
