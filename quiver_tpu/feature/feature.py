@@ -116,14 +116,17 @@ def resolve_gather_kernel(kernel: str) -> str:
     """Resolve the hot-tier gather kernel choice. Touches the backend, so
     callers defer this to first use (never the constructor).
 
-    ``"auto"`` picks the Pallas row-DMA kernel (ops/pallas/gather.py — the
-    ``quiver_tensor_gather`` analogue, shard_tensor.cu.hpp:16-58) on TPU,
-    stock XLA take elsewhere (the Pallas interpreter on CPU is correct but
-    slow; XLA's CPU gather is fine). On TPU, auto additionally proves the
-    kernel compiles and gathers correctly once per process before electing
-    it — a Pallas regression degrades auto to xla with a warning instead of
-    taking down every feature gather. An explicit ``kernel="pallas"``
-    bypasses the check (fail loudly on request).
+    ``"auto"`` on TPU ELECTS BY MEASURED THROUGHPUT between the Pallas
+    row-DMA kernel (ops/pallas/gather.py — the ``quiver_tensor_gather``
+    analogue, shard_tensor.cu.hpp:16-58) and the stock XLA take: a
+    correctness smoke gates Pallas (a regression degrades auto to xla with
+    a warning), then a 2-candidate fused-scan micro-bench picks the faster
+    kernel — "it compiled and returned right rows" is not evidence it is
+    fast (VERDICT r3 item 4). The election is cached per process and on
+    disk (keyed by device kind), and ``QUIVER_GATHER_KERNEL=pallas|xla``
+    overrides it. Off-TPU auto is xla (the Pallas CPU path is correct but
+    slow). An explicit ``kernel="pallas"`` bypasses everything (fail loudly
+    on request).
     """
     validate_gather_kernel(kernel)
     if kernel == "auto":
@@ -133,7 +136,7 @@ def resolve_gather_kernel(kernel: str) -> str:
             return "xla"
         if backend != "tpu":
             return "xla"
-        return "pallas" if _pallas_gather_usable() else "xla"
+        return _elect_gather_kernel()
     return kernel
 
 
@@ -167,6 +170,119 @@ def _pallas_gather_usable() -> bool:
             )
             _PALLAS_GATHER_OK = False
     return _PALLAS_GATHER_OK
+
+
+def _measure_gather_gbps(kernel: str, rows: int = 65536, dim: int = 128,
+                         batch: int = 8192, reps: int = 16) -> float:
+    """Median GB/s of one gather kernel over a fused id-scan.
+
+    Dispatch-clean by construction (the round-3 lesson: per-call loops over
+    a tunneled link measure the link): ONE program scans ``reps`` distinct
+    id batches — distinct so XLA cannot hoist the gather out of the scan —
+    with a checksum carry keeping every gathered column live, and one
+    scalar readback ends the clock.
+    """
+    import time
+
+    from jax import lax
+
+    table = jnp.arange(rows * dim, dtype=jnp.float32).reshape(rows, dim)
+    ids_mat = jax.random.randint(
+        jax.random.PRNGKey(0), (reps, batch), 0, rows, dtype=jnp.int32
+    )
+    gather = _hot_gather_fn(table, kernel)
+
+    @jax.jit
+    def run(ids_all):
+        def step(carry, ids):
+            return carry + jnp.sum(gather(ids)), None
+        total, _ = lax.scan(step, jnp.float32(0), ids_all)
+        return total
+
+    jax.block_until_ready(run(ids_mat))  # compile
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(run(ids_mat))
+        times.append(time.time() - t0)
+    nbytes = reps * batch * dim * 4
+    return nbytes / sorted(times)[1] / 1e9
+
+
+_GATHER_ELECTION: dict | None = None
+
+# bump when either gather kernel's implementation changes: the disk cache
+# is keyed on this + the jax version + the device kind, so a kernel or
+# toolchain change forces re-election instead of trusting stale numbers
+_ELECTION_REV = 1
+
+
+def _election_cache_key() -> str:
+    return f"rev{_ELECTION_REV}-jax{jax.__version__}-" + str(
+        jax.devices()[0].device_kind
+    )
+
+
+def _election_cache_path() -> str:
+    import os
+
+    return os.environ.get(
+        "QUIVER_ELECTION_CACHE",
+        os.path.expanduser("~/.cache/quiver_tpu/gather_election.json"),
+    )
+
+
+def _elect_gather_kernel() -> str:
+    """TPU kernel=auto election: measured pallas-vs-xla GB/s, not compile
+    success. Cached per process and on disk so every supervised benchmark
+    subprocess doesn't re-pay the two micro-bench compiles."""
+    import json
+    import os
+
+    global _GATHER_ELECTION
+    if _GATHER_ELECTION is not None:
+        return _GATHER_ELECTION["kernel"]
+    log = get_logger("feature")
+    forced = os.environ.get("QUIVER_GATHER_KERNEL", "").strip().lower()
+    if forced in ("pallas", "xla"):
+        _GATHER_ELECTION = {"kernel": forced, "how": "env override"}
+        return forced
+    if not _pallas_gather_usable():
+        _GATHER_ELECTION = {"kernel": "xla", "how": "pallas smoke failed"}
+        return "xla"
+    cache_key = _election_cache_key()
+    path = _election_cache_path()
+    try:
+        with open(path) as f:
+            cached = json.load(f)
+        if cached.get("key") == cache_key and cached.get(
+                "kernel") in ("pallas", "xla"):
+            _GATHER_ELECTION = {**cached, "how": "disk cache"}
+            log.info("gather kernel=auto -> %s (cached election: %s)",
+                     cached["kernel"], cached.get("gbps"))
+            return cached["kernel"]
+    except (OSError, ValueError):
+        pass
+    try:
+        gbps = {k: round(_measure_gather_gbps(k), 2)
+                for k in ("xla", "pallas")}
+        kernel = max(gbps, key=gbps.get)
+    except Exception as e:  # noqa: BLE001 — a bench failure must not take
+        # down every feature gather; fall back to the safe default
+        log.warning("gather kernel election failed (%s: %s); auto -> xla",
+                    type(e).__name__, str(e)[:200])
+        _GATHER_ELECTION = {"kernel": "xla", "how": "election failed"}
+        return "xla"
+    _GATHER_ELECTION = {"kernel": kernel, "gbps": gbps,
+                        "key": cache_key, "how": "measured"}
+    log.info("gather kernel=auto -> %s (measured GB/s: %s)", kernel, gbps)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"kernel": kernel, "gbps": gbps, "key": cache_key}, f)
+    except OSError:
+        pass
+    return kernel
 
 
 def _hot_gather_fn(table, kernel: str):

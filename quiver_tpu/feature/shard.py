@@ -223,10 +223,11 @@ class ShardedTensor(KernelChoice):
         n = ids.shape[0]
         pad = (-n) % mult
         if pad:
-            # -1 = the documented invalid-lane sentinel: padded lanes skip
-            # the gather instead of becoming real requests for row 0
-            # (psum-path local_gather treats any non-owned id as zeros, so
-            # -1 is safe there too)
+            # -1 = the documented invalid-lane sentinel. Padded lanes are
+            # still routed and gathered (routed_gather remaps them to row-0
+            # requests), but their results are zeroed — correct output, not
+            # skipped work. (psum-path local_gather treats any non-owned id
+            # as zeros, so -1 is safe there too.)
             ids = jnp.concatenate([ids, jnp.full(pad, -1, ids.dtype)])
         out = self._gather_fn(ids.shape[0], ids.dtype, routed)(self.table, ids)
         return out[:n] if pad else out

@@ -73,6 +73,13 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
         table (reindex.cu.hpp:120-139 atomicMin keeps the first
         occurrence); the dense map plays the table, scatter-min plays
         atomicMin. Same contract either way; pick by measurement.
+        WARNING — silent corruption if violated: a valid id >= node_bound
+        is dropped by the scatter (mode="drop") and its gather clamps to
+        the last map slot, so the output is WRONG with no error raised;
+        the sort path tolerates arbitrary id values. Callers must derive
+        node_bound from the id space that produced ``ids`` (the samplers
+        pass topo.node_count; neighbor ids are CSR entries < node_count by
+        construction).
 
     Returns:
       uniq: (size,) unique ids in first-occurrence order, -1 padded.

@@ -1,0 +1,92 @@
+"""kernel=auto election: by measured throughput, not compile success
+(VERDICT r3 item 4)."""
+
+import json
+
+import pytest
+
+from quiver_tpu.feature import feature as F
+
+
+@pytest.fixture(autouse=True)
+def fresh_election(tmp_path, monkeypatch):
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setenv("QUIVER_ELECTION_CACHE",
+                       str(tmp_path / "election.json"))
+    monkeypatch.delenv("QUIVER_GATHER_KERNEL", raising=False)
+    yield tmp_path / "election.json"
+
+
+def test_measure_gather_gbps_runs():
+    gbps = F._measure_gather_gbps("xla", rows=512, dim=8, batch=64, reps=4)
+    assert gbps > 0
+
+
+def test_election_picks_measured_winner(fresh_election, monkeypatch):
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 10.0, "pallas": 4.0}[k])
+    assert F._elect_gather_kernel() == "xla"
+    assert F._GATHER_ELECTION["how"] == "measured"
+    # and the loser would have won with the numbers flipped
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setenv("QUIVER_ELECTION_CACHE",
+                       str(fresh_election.parent / "election2.json"))
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 4.0, "pallas": 10.0}[k])
+    assert F._elect_gather_kernel() == "pallas"
+
+
+def test_election_disk_cache_roundtrip(fresh_election, monkeypatch):
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 1.0, "pallas": 9.0}[k])
+    assert F._elect_gather_kernel() == "pallas"
+    cached = json.loads(fresh_election.read_text())
+    assert cached["kernel"] == "pallas" and cached["gbps"]["pallas"] == 9.0
+
+    # a fresh process (reset global) must trust the cache, not re-measure
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+
+    def boom(k, **kw):
+        raise AssertionError("re-measured despite disk cache")
+
+    monkeypatch.setattr(F, "_measure_gather_gbps", boom)
+    assert F._elect_gather_kernel() == "pallas"
+    assert F._GATHER_ELECTION["how"] == "disk cache"
+
+    # ...but a different cache key (device kind / jax version / kernel
+    # revision) invalidates it
+    cached["key"] = "rev0-jaxother-chip"
+    fresh_election.write_text(json.dumps(cached))
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 9.0, "pallas": 1.0}[k])
+    assert F._elect_gather_kernel() == "xla"
+
+
+def test_election_env_override_and_failsafes(fresh_election, monkeypatch):
+    monkeypatch.setenv("QUIVER_GATHER_KERNEL", "xla")
+    assert F._elect_gather_kernel() == "xla"
+    assert F._GATHER_ELECTION["how"] == "env override"
+
+    # failed pallas smoke short-circuits to xla without measuring
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.delenv("QUIVER_GATHER_KERNEL")
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: False)
+    assert F._elect_gather_kernel() == "xla"
+
+    # a measurement crash degrades to xla instead of raising
+    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+
+    def boom(k, **kw):
+        raise RuntimeError("chip went away")
+
+    monkeypatch.setattr(F, "_measure_gather_gbps", boom)
+    assert F._elect_gather_kernel() == "xla"
+    assert F._GATHER_ELECTION["how"] == "election failed"
