@@ -43,13 +43,14 @@ def main():
     p.add_argument(
         "--dedup",
         default="sort",
-        choices=["sort", "map", "both"],
-        help="reindex dedup strategy: stable-sort run-scan or the sort-free "
-        "dense-map scatter-min (reference hash-table analogue). 'both' "
-        "(stream mode) measures the two in one process — sharing the "
-        "device topology and the planned caps — and emits the faster "
-        "stream record FIRST, so the headline self-selects the winning "
-        "strategy on whatever backend it runs on",
+        choices=["sort", "map", "scan", "both"],
+        help="reindex dedup strategy: stable-sort run-scan, the sort-free "
+        "dense-map scatter-min (reference hash-table analogue), or the "
+        "zero-scatter sort/cummax/gather 'scan'. 'both' (stream mode) "
+        "measures ALL strategies in one process — sharing the device "
+        "topology and the planned caps — and emits the faster stream "
+        "record FIRST, so the headline self-selects the winning strategy "
+        "on whatever backend it runs on",
     )
     p.add_argument(
         "--weighted", action="store_true",
@@ -159,7 +160,8 @@ def _stage_profile(args, sampler, topo, reps: int = 30):
         )
         f_reindex = jax.jit(
             lambda c, n, nb, fc=caps[l]: reindex_layer(
-                c, n, nb, fc, node_bound=nb_bound
+                c, n, nb, fc, node_bound=nb_bound,
+                scatter_free=(sampler.dedup == "scan"),
             )
         )
         (frontier, n_frontier, _, _), t_reindex = timed(
@@ -199,10 +201,11 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     matrix H2D and the scalar readback. Valid edges only (BASELINE.md
     honesty rule).
 
-    ``--dedup both``: a second sampler measures the dense-map strategy in
-    the same process (sharing the device topology and the already-planned
-    caps); records are emitted fastest-first so the supervisor's
-    first-SEPS-record headline self-selects the winner on this backend.
+    ``--dedup both``: extra samplers measure the dense-map and zero-scatter
+    scan strategies in the same process (sharing the device topology and
+    the already-planned caps); records are emitted fastest-first so the
+    supervisor's first-SEPS-record headline self-selects the winner on
+    this backend.
     """
     from quiver_tpu import GraphSageSampler
 
@@ -210,17 +213,18 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
 
     candidates = [(sampler.dedup, sampler)]
     if args.dedup == "both":
-        other = GraphSageSampler(
-            topo, args.fanout, mode=args.mode, seed_capacity=cap,
-            seed=args.seed, kernel=args.kernel, dedup="map",
-            weighted=sampler.weighted,
-            frontier_caps=(
-                tuple(sampler._frontier_caps)
-                if sampler._frontier_caps is not None else None
-            ),
-            device_topo=sampler.topo,
-        )
-        candidates.append(("map", other))
+        for dedup in ("map", "scan"):
+            other = GraphSageSampler(
+                topo, args.fanout, mode=args.mode, seed_capacity=cap,
+                seed=args.seed, kernel=args.kernel, dedup=dedup,
+                weighted=sampler.weighted,
+                frontier_caps=(
+                    tuple(sampler._frontier_caps)
+                    if sampler._frontier_caps is not None else None
+                ),
+                device_topo=sampler.topo,
+            )
+            candidates.append((dedup, other))
 
     results = []
     for dedup, s in candidates:

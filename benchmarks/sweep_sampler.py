@@ -31,8 +31,8 @@ def main():
     p.add_argument("--fanout", type=int, nargs="+", default=[15, 10, 5])
     p.add_argument("--batches", type=int, nargs="+",
                    default=[2048, 4096, 8192])
-    p.add_argument("--dedups", nargs="+", default=["sort", "map"],
-                   choices=["sort", "map"])
+    p.add_argument("--dedups", nargs="+", default=["sort", "map", "scan"],
+                   choices=["sort", "map", "scan"])
     p.add_argument("--stream", type=int, default=64)
     p.add_argument("--reps", type=int, default=3)
     args = p.parse_args()

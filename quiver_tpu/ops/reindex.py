@@ -14,6 +14,7 @@ contract.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 __all__ = [
     "masked_unique",
@@ -52,7 +53,8 @@ def complete_permutation(p, n: int):
 
 
 def masked_unique(ids, valid, size: int, num_forced: int = 0,
-                  node_bound: int | None = None):
+                  node_bound: int | None = None,
+                  scatter_free: bool = False):
     """First-occurrence-order unique of ``ids[valid]``, padded to ``size``.
 
     Args:
@@ -80,6 +82,15 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
         node_bound from the id space that produced ``ids`` (the samplers
         pass topo.node_count; neighbor ids are CSR entries < node_count by
         construction).
+      scatter_free: use the ZERO-SCATTER strategy (``dedup="scan"``): three
+        sorts + a cumulative max + gathers, no ``.at[].set/min`` anywhere —
+        including the output compaction, which the other two strategies do
+        with a scatter. Rationale: the round-3 link characterization
+        measured TPU sort at ~1.8 ms/M elements while the reindex stage ran
+        tens of ms — XLA scatters with non-trivial index patterns can
+        serialize on TPU, so a strategy whose only data movement is sorts,
+        scans, and gathers is the natural third candidate. Same contract;
+        pick by measurement (ignored when ``node_bound`` is given).
 
     Returns:
       uniq: (size,) unique ids in first-occurrence order, -1 padded.
@@ -99,6 +110,27 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
             .min(jnp.where(valid, pos, T), mode="drop")
         )
         rep_pos = first_pos[safe]
+    elif scatter_free:
+        sent = jnp.iinfo(ids.dtype).max
+        vals = jnp.where(valid, ids, sent)
+        order = jnp.argsort(vals, stable=True)
+        sv = vals[order]
+        pv = pos[order]
+        first = jnp.concatenate(
+            [jnp.ones(1, bool), sv[1:] != sv[:-1]]
+        ) & (sv != sent)
+        # sorted-view index of the current run's first element: a running
+        # max over first-markers (the scatter-free run-representative)
+        idx_first = lax.cummax(
+            jnp.where(first, jnp.arange(T, dtype=jnp.int32), -1)
+        )
+        rep_pos_sorted = jnp.where(
+            idx_first >= 0, pv[jnp.clip(idx_first, 0)], T
+        )
+        # back to original positions via the inverse permutation, built by
+        # sorting the permutation instead of scattering into it
+        inv = jnp.argsort(order).astype(jnp.int32)
+        rep_pos = rep_pos_sorted[inv]
     else:
         sent = jnp.iinfo(ids.dtype).max
         vals = jnp.where(valid, ids, sent)
@@ -126,18 +158,31 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
     rank = jnp.cumsum(is_rep.astype(jnp.int32)) - 1  # first-occurrence rank
     num_unique = jnp.sum(is_rep.astype(jnp.int32))
 
-    uniq = (
-        jnp.full(size, -1, ids.dtype)
-        .at[jnp.where(is_rep & (rank < size), rank, size)]
-        .set(ids, mode="drop")
-    )
+    if scatter_free and node_bound is None:
+        # compaction by sort: reps first in ascending-position (=rank)
+        # order, everything else after — keys are distinct so no stability
+        # needed, and the (size,) write is a contiguous slice update, not
+        # a scatter
+        comp_order = jnp.argsort(jnp.where(is_rep, pos, T + pos))
+        m = min(size, T)
+        packed = jnp.where(
+            jnp.arange(m) < num_unique, ids[comp_order[:m]], -1
+        ).astype(ids.dtype)
+        uniq = jnp.full(size, -1, ids.dtype).at[:m].set(packed)
+    else:
+        uniq = (
+            jnp.full(size, -1, ids.dtype)
+            .at[jnp.where(is_rep & (rank < size), rank, size)]
+            .set(ids, mode="drop")
+        )
     local = rank[rep_pos]
     local = jnp.where(valid & (local < size), local, -1)
     return uniq, num_unique, local
 
 
 def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int,
-                  node_bound: int | None = None):
+                  node_bound: int | None = None,
+                  scatter_free: bool = False):
     """Per-layer reindex: frontier = unique(seeds ∪ neighbors), seeds first.
 
     Mirrors the reference's ``reindex_single`` contract
@@ -150,6 +195,8 @@ def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int,
       frontier_cap: static capacity of the output frontier.
       node_bound: optional static id upper bound enabling the sort-free
         scatter-min dedup (see masked_unique).
+      scatter_free: the zero-scatter sort/scan/gather strategy
+        (see masked_unique; ignored when node_bound is given).
 
     Returns:
       frontier: (frontier_cap,) unique node ids, seeds first, -1 padded.
@@ -165,7 +212,8 @@ def reindex_layer(seeds, num_seeds, neighbors, frontier_cap: int,
     valid = jnp.concatenate([seed_valid, nbr_valid])
 
     uniq, num_unique, local = masked_unique(
-        ids, valid, frontier_cap, num_forced=S, node_bound=node_bound
+        ids, valid, frontier_cap, num_forced=S, node_bound=node_bound,
+        scatter_free=scatter_free,
     )
     col_local = local[S:].reshape(S, K)
     num_frontier = jnp.minimum(num_unique, frontier_cap)

@@ -141,7 +141,8 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
                 int(topo.indptr.shape[0]) - 1 if dedup == "map" else None
             )
             frontier, n_frontier, col, overflow = reindex_layer(
-                cur, cur_n, nbr, caps[l], node_bound=node_bound
+                cur, cur_n, nbr, caps[l], node_bound=node_bound,
+                scatter_free=(dedup == "scan"),
             )
         S = cur.shape[0]
         row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
@@ -193,9 +194,11 @@ class GraphSageSampler:
         (reference sage_sampler.py:100-109) — COO positions when the
         topology tracks ``eid``, CSR slots otherwise. XLA kernel only.
       dedup: reindex first-occurrence strategy — "sort" (stable sort +
-        run scan) or "map" (sort-free scatter-min into a dense
-        (node_count,) position map, the reference hash-table analogue,
-        reindex.cu.hpp:120-139). Identical results; pick by measurement.
+        run scan), "map" (sort-free scatter-min into a dense (node_count,)
+        position map, the reference hash-table analogue,
+        reindex.cu.hpp:120-139), or "scan" (zero-scatter: sorts +
+        cumulative max + gathers only — for backends where XLA scatter
+        serializes). Identical results; pick by measurement.
       device_topo: advanced — reuse an existing DeviceTopology (built with
         compatible to_device flags) instead of uploading a fresh copy;
         lets many sampler configurations share one device-resident graph.
@@ -229,8 +232,10 @@ class GraphSageSampler:
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
         self.dedup = str(dedup)
-        if self.dedup not in ("sort", "map"):
-            raise ValueError(f"dedup must be 'sort' or 'map', got {dedup!r}")
+        if self.dedup not in ("sort", "map", "scan"):
+            raise ValueError(
+                f"dedup must be 'sort', 'map', or 'scan', got {dedup!r}"
+            )
         if self.kernel == "pallas":
             if weighted:
                 raise ValueError("kernel='pallas' supports unweighted sampling only")

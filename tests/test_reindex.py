@@ -76,10 +76,11 @@ def test_masked_unique_random_vs_python():
                 assert la[p] == -1
 
 
-def test_masked_unique_map_matches_sort():
-    """The sort-free dense-map dedup (node_bound) must be bit-identical to
-    the sort path on every output, across duplicates, invalid lanes,
-    forced (duplicated) seed lanes, and capacity overflow."""
+def test_masked_unique_alternatives_match_sort():
+    """The sort-free dense-map dedup (node_bound) AND the zero-scatter scan
+    dedup must be bit-identical to the sort path on every output, across
+    duplicates, invalid lanes, forced (duplicated) seed lanes, and capacity
+    overflow."""
     rng = np.random.default_rng(7)
     for trial in range(20):
         t = int(rng.integers(1, 300))
@@ -92,19 +93,33 @@ def test_masked_unique_map_matches_sort():
             jnp.asarray(ids), jnp.asarray(valid), size=size,
             num_forced=forced,
         )
-        got_map = masked_unique(
-            jnp.asarray(ids), jnp.asarray(valid), size=size,
-            num_forced=forced, node_bound=bound,
-        )
-        for a, b, name in zip(got, got_map, ("uniq", "n", "local")):
-            assert np.array_equal(np.asarray(a), np.asarray(b)), (
-                trial, name, np.asarray(a), np.asarray(b)
+        for kw in ({"node_bound": bound}, {"scatter_free": True}):
+            alt = masked_unique(
+                jnp.asarray(ids), jnp.asarray(valid), size=size,
+                num_forced=forced, **kw,
             )
+            for a, b, name in zip(got, alt, ("uniq", "n", "local")):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                    trial, kw, name, np.asarray(a), np.asarray(b)
+                )
 
 
-def test_sampler_dedup_map_matches_sort():
-    """End-to-end: GraphSageSampler(dedup='map') reproduces dedup='sort'
-    exactly (same seed, same key path)."""
+def test_masked_unique_scan_all_invalid_and_oversize():
+    """Scan-strategy edge cases: every lane invalid, and size > T."""
+    ids = jnp.asarray([5, 5, 2])
+    none = jnp.zeros(3, bool)
+    uniq, n, local = masked_unique(ids, none, size=6, scatter_free=True)
+    assert int(n) == 0
+    assert np.all(np.asarray(uniq) == -1) and np.all(np.asarray(local) == -1)
+    uniq, n, local = masked_unique(ids, jnp.ones(3, bool), size=6,
+                                   scatter_free=True)
+    assert list(np.asarray(uniq)) == [5, 2, -1, -1, -1, -1]
+    assert int(n) == 2 and list(np.asarray(local)) == [0, 0, 1]
+
+
+def test_sampler_dedup_alternatives_match_sort():
+    """End-to-end: GraphSageSampler(dedup='map'|'scan') reproduces
+    dedup='sort' exactly (same seed, same key path)."""
     from quiver_tpu import CSRTopo, GraphSageSampler
 
     rng = np.random.default_rng(3)
@@ -112,15 +127,17 @@ def test_sampler_dedup_map_matches_sort():
     topo = CSRTopo(edge_index=ei)
     seeds = rng.integers(0, topo.node_count, 64)
     outs = {}
-    for dedup in ("sort", "map"):
+    for dedup in ("sort", "map", "scan"):
         s = GraphSageSampler(topo, [5, 3], seed=11, dedup=dedup)
         outs[dedup] = s.sample(seeds)
-    a, b = outs["sort"], outs["map"]
-    assert np.array_equal(np.asarray(a.n_id), np.asarray(b.n_id))
-    for adj_a, adj_b in zip(a.adjs, b.adjs):
-        assert np.array_equal(
-            np.asarray(adj_a.edge_index), np.asarray(adj_b.edge_index)
-        )
+    a = outs["sort"]
+    for other in ("map", "scan"):
+        b = outs[other]
+        assert np.array_equal(np.asarray(a.n_id), np.asarray(b.n_id)), other
+        for adj_a, adj_b in zip(a.adjs, b.adjs):
+            assert np.array_equal(
+                np.asarray(adj_a.edge_index), np.asarray(adj_b.edge_index)
+            ), other
 
 
 def test_sampler_device_topo_reuse():
