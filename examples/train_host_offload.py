@@ -25,6 +25,10 @@ import time
 
 import numpy as np
 
+from quiver_tpu.utils.backend import honor_forced_platform
+
+honor_forced_platform()  # an explicit JAX_PLATFORMS=cpu must win over sitecustomize
+
 import jax
 
 if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():

@@ -23,6 +23,10 @@ import time
 
 import numpy as np
 
+from quiver_tpu.utils.backend import honor_forced_platform
+
+honor_forced_platform()  # an explicit JAX_PLATFORMS=cpu must win over sitecustomize
+
 import jax
 
 # the image's sitecustomize pins jax to the TPU plugin at startup, which

@@ -15,6 +15,10 @@ import time
 
 import numpy as np
 
+from quiver_tpu.utils.backend import honor_forced_platform
+
+honor_forced_platform()  # an explicit JAX_PLATFORMS=cpu must win over sitecustomize
+
 import jax
 import jax.numpy as jnp
 import optax

@@ -12,6 +12,7 @@ __all__ = [
     "get_logger",
     "start_trace",
     "stop_trace",
+    "honor_forced_platform",
 ]
 
 _LAZY = {
@@ -28,6 +29,7 @@ _LAZY = {
     "get_logger": "trace",
     "start_trace": "trace",
     "stop_trace": "trace",
+    "honor_forced_platform": "backend",
 }
 
 

@@ -1,0 +1,35 @@
+"""Backend-selection plumbing shared by examples and scripts.
+
+Some deployment images register an accelerator plugin from
+``sitecustomize`` at interpreter start — BEFORE user env vars are read —
+which silently overrides ``JAX_PLATFORMS=cpu``. Backend init is lazy, so
+an explicit ``jax.config`` update still wins as long as it happens before
+the first device touch. The benchmark harness applies this itself
+(benchmarks/common.init_backend); examples call :func:`honor_forced_platform`.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["honor_forced_platform"]
+
+
+def honor_forced_platform() -> bool:
+    """Apply an explicit ``JAX_PLATFORMS=cpu`` request via jax.config.
+
+    Exact match only — a priority list like ``"tpu,cpu"`` is jax's business,
+    not a forced-CPU request. Must run before the first backend touch.
+    Returns True when CPU was forced.
+    """
+    plats = [
+        p.strip().lower()
+        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    ]
+    if plats == ["cpu"]:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
