@@ -113,7 +113,8 @@ def _normalize_sizes(sizes, topo: HeteroCSRTopo):
 
 def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
                              layer_plans, weighted_rels=frozenset(),
-                             with_eid: bool = False, node_bounds=None):
+                             with_eid: bool = False, node_bounds=None,
+                             scatter_free: bool = False):
     """The jit-composable hetero sampling loop.
 
     ``layer_plans`` is a static tuple of per-hop plans, each
@@ -126,7 +127,8 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
     relations: ids are COO positions within each relation's own edge list.
     ``node_bounds`` (static {type: node_count} or None) switches the
     per-type dedup to the sort-free dense-map scatter-min, matching the
-    homogeneous ``dedup='map'`` option.
+    homogeneous ``dedup='map'`` option; ``scatter_free`` selects the
+    zero-scatter scan strategy (homogeneous ``dedup='scan'``).
     Returns (frontier dict, counts dict, layers deepest-first, overflow).
     """
     frontier = {input_type: seeds}
@@ -178,6 +180,7 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
             uniq, num_u, local = masked_unique(
                 ids, valid, cap, num_forced=n_prev,
                 node_bound=None if node_bounds is None else node_bounds[t],
+                scatter_free=scatter_free,
             )
             new_frontier[t] = uniq
             new_counts[t] = jnp.minimum(num_u, cap)
@@ -241,9 +244,10 @@ class HeteroGraphSampler:
         ids (COO positions) — the homogeneous sampler's contract
         (sage_sampler.py:100-109 parity) extended to typed graphs.
       dedup: per-type frontier first-occurrence strategy — "sort" (stable
-        sort + run scan) or "map" (sort-free scatter-min into a dense
-        per-type position map). Identical results; pick by measurement.
-        Mirrors the homogeneous GraphSageSampler option.
+        sort + run scan), "map" (sort-free scatter-min into a dense
+        per-type position map), or "scan" (zero-scatter sorts + cummax +
+        gathers). Identical results; pick by measurement. Mirrors the
+        homogeneous GraphSageSampler option.
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
@@ -255,8 +259,10 @@ class HeteroGraphSampler:
         if input_type not in topo.num_nodes:
             raise ValueError(f"unknown input_type {input_type!r}")
         self.dedup = str(dedup)
-        if self.dedup not in ("sort", "map"):
-            raise ValueError(f"dedup must be 'sort' or 'map', got {dedup!r}")
+        if self.dedup not in ("sort", "map", "scan"):
+            raise ValueError(
+                f"dedup must be 'sort', 'map', or 'scan', got {dedup!r}"
+            )
         self.topo = topo
         self.input_type = input_type
         self.sizes = _normalize_sizes(sizes, topo)
@@ -375,13 +381,14 @@ class HeteroGraphSampler:
             {t: int(n) for t, n in self.topo.num_nodes.items()}
             if self.dedup == "map" else None
         )
+        scatter_free = self.dedup == "scan"
 
         @jax.jit
         def run(dev_topos, seeds, num_seeds, key):
             return hetero_multilayer_sample(
                 dev_topos, seeds, num_seeds, key, input_type, plans,
                 weighted_rels=weighted_rels, with_eid=with_eid,
-                node_bounds=node_bounds,
+                node_bounds=node_bounds, scatter_free=scatter_free,
             )
 
         self._compiled_cache[cache_key] = run
