@@ -130,6 +130,43 @@ def test_fused_cold_tier_matches_full_hbm():
     np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("seed_sharding", ["data", "all"])
+def test_fused_sharded_cold_tier_matches_full(seed_sharding):
+    """Mesh-sharded hot tier + pinned-host cold tier through the fused
+    step: the psum/routed hot gather and the staged cold gather compose in
+    one shard_map program, and tiering must not change the math."""
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    results = []
+    for budget in ("1G", (n // 2) * feat.shape[1] * 4 // 2):
+        sampler = GraphSageSampler(topo, [5, 5], seed=3)
+        feature = ShardedFeature(
+            mesh, device_cache_size=budget
+        ).from_cpu_tensor(feat[:n])
+        if budget != "1G":
+            assert feature.cold is not None, feature.cache_ratio
+        trainer = DistributedTrainer(
+            mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32,
+            seed_sharding=seed_sharding,
+        )
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        losses = []
+        for step in range(3):
+            seeds = rng.integers(0, n, trainer.global_batch)
+            params, opt_state, loss = trainer.step(
+                params, opt_state, seeds, labels_dev, jax.random.PRNGKey(step)
+            )
+            losses.append(float(loss))
+        results.append(losses)
+    assert results[1][0] > 0 and np.all(np.isfinite(results[1]))
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
+
+
 def test_fused_int8_feature_dequantizes():
     """ADVICE r3: the fused gather must dequantize int8 storage (scale is
     applied inside the shard_map program), not train on raw codes. With
