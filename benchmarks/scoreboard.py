@@ -247,7 +247,9 @@ def main():
             metric = rec.get("metric", "?")
             extras = {k: v for k, v in rec.items()
                       if k in ("kernel", "mode", "policy", "caps", "sampler",
-                               "layer", "stage", "dispatch", "stream_batches", "dedup")}
+                               "layer", "stage", "dispatch", "stream_batches",
+                               "dedup", "roofline_frac", "topo_mode",
+                               "cache_ratio", "elected")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(
