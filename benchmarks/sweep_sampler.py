@@ -47,8 +47,14 @@ def _body(args):
     rng = np.random.default_rng(args.seed)
     dev_topo = topo.to_device(SampleMode.HBM)  # shared across every config
 
-    for dedup in args.dedups:
-        for batch in args.batches:
+    # evidence-ordered: the strategy head-to-head at the headline batch
+    # first (a short chip window must decide dedup before batch scaling)
+    grid = sorted(
+        ((d, b) for d in args.dedups for b in args.batches),
+        key=lambda db: (db[1] != args.batches[0], args.batches.index(db[1]),
+                        args.dedups.index(db[0])),
+    )
+    for dedup, batch in grid:
             log(f"config dedup={dedup} batch={batch}")
             sampler = GraphSageSampler(
                 topo, args.fanout, mode="HBM", seed_capacity=batch,
