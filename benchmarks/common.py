@@ -108,15 +108,13 @@ def hbm_bandwidth_gbps() -> float | None:
 
         d = jax.devices()[0]
         if d.platform == "tpu":
+            # normalize "TPU v5 lite" / "tpu-v5e" spellings before matching
             kind = str(getattr(d, "device_kind", "")).lower()
-            if "v5" in kind and ("lite" in kind or "v5e" in kind):
-                return 819.0
-            if "v5p" in kind or ("v5" in kind and "p" in kind.split("v5")[-1][:2]):
-                return 2765.0
-            if "v6" in kind:
-                return 1640.0
-            if "v4" in kind:
-                return 1228.0
+            kind = kind.replace(" ", "").replace("-", "").replace("_", "")
+            for tag, bw in (("v5lite", 819.0), ("v5e", 819.0),
+                            ("v5p", 2765.0), ("v6", 1640.0), ("v4", 1228.0)):
+                if tag in kind:
+                    return bw
             # unrecognized TPU: no ceiling is better than a made-up one
     except Exception:  # noqa: BLE001
         pass

@@ -110,7 +110,10 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
             .min(jnp.where(valid, pos, T), mode="drop")
         )
         rep_pos = first_pos[safe]
-    elif scatter_free:
+    else:
+        # shared sorted view: stable value sort, run starts (sentinel run
+        # excluded); positions within a run ascend, so a run's first sorted
+        # element IS the value's first occurrence
         sent = jnp.iinfo(ids.dtype).max
         vals = jnp.where(valid, ids, sent)
         order = jnp.argsort(vals, stable=True)
@@ -119,39 +122,31 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
         first = jnp.concatenate(
             [jnp.ones(1, bool), sv[1:] != sv[:-1]]
         ) & (sv != sent)
-        # sorted-view index of the current run's first element: a running
-        # max over first-markers (the scatter-free run-representative)
-        idx_first = lax.cummax(
-            jnp.where(first, jnp.arange(T, dtype=jnp.int32), -1)
-        )
-        rep_pos_sorted = jnp.where(
-            idx_first >= 0, pv[jnp.clip(idx_first, 0)], T
-        )
-        # back to original positions via the inverse permutation, built by
-        # sorting the permutation instead of scattering into it
-        inv = jnp.argsort(order).astype(jnp.int32)
-        rep_pos = rep_pos_sorted[inv]
-    else:
-        sent = jnp.iinfo(ids.dtype).max
-        vals = jnp.where(valid, ids, sent)
 
-        order = jnp.argsort(vals, stable=True)
-        sv = vals[order]
-        pv = pos[order]
-
-        # run starts in the sorted view (sentinel run excluded)
-        first = jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]]) & (sv != sent)
-        run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
-        # representative position (== first occurrence, because the sort is
-        # stable and positions within a run are ascending) scattered per run
-        by_run = (
-            jnp.zeros(T, jnp.int32)
-            .at[jnp.where(first, run_id, T)]
-            .set(pv, mode="drop")
-        )
-        rep_pos_sorted = by_run[jnp.clip(run_id, 0)]
-        # back to original positions
-        rep_pos = jnp.zeros(T, jnp.int32).at[order].set(rep_pos_sorted)
+        if scatter_free:
+            # sorted-view index of the current run's first element: a
+            # running max over first-markers (the scatter-free
+            # run-representative)
+            idx_first = lax.cummax(
+                jnp.where(first, jnp.arange(T, dtype=jnp.int32), -1)
+            )
+            rep_pos_sorted = jnp.where(
+                idx_first >= 0, pv[jnp.clip(idx_first, 0)], T
+            )
+            # back to original positions via the inverse permutation, built
+            # by sorting the permutation instead of scattering into it
+            rep_pos = rep_pos_sorted[jnp.argsort(order).astype(jnp.int32)]
+        else:
+            run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+            # representative position scattered per run
+            by_run = (
+                jnp.zeros(T, jnp.int32)
+                .at[jnp.where(first, run_id, T)]
+                .set(pv, mode="drop")
+            )
+            rep_pos_sorted = by_run[jnp.clip(run_id, 0)]
+            # back to original positions
+            rep_pos = jnp.zeros(T, jnp.int32).at[order].set(rep_pos_sorted)
 
     forced = (pos < num_forced) & valid
     is_rep = (valid & (rep_pos == pos)) | forced
