@@ -35,6 +35,9 @@ JOBS = [
      ["--mode", "HBM", "--stages", "--stream", "128", "--dedup", "both"],
      "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41); sort AND "
      "dense-map dedup measured, fastest first"),
+    ("primitives", "benchmarks.microbench", [],
+     "sort/scatter/gather/cummax Melem/s — decides which dedup strategy "
+     "SHOULD win on this chip (scatter-serialization diagnosis), ~2 min"),
     ("feature-replicate", "benchmarks.bench_feature",
      ["--policy", "replicate", "--stream", "32"],
      "ref 14.82 GB/s (1 GPU, 20% cache, Introduction_en.md:95)"),
