@@ -219,6 +219,26 @@ def test_scoreboard_timeout_keeps_partial_records(monkeypatch):
     assert str(err).startswith("timeout")
 
 
+def test_microbench_emits_all_primitives():
+    """The primitive microbench must produce one record per building block
+    (the chip-window diagnosis depends on all six being present)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.microbench", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    recs = [json.loads(l) for l in r.stdout.splitlines()
+            if l.strip().startswith("{")]
+    ops = {x["op"] for x in recs if x["metric"] == "primitive-Melem/s"}
+    assert ops == {"sort", "argsort-pair", "gather", "scatter-set",
+                   "scatter-min", "cummax"}, r.stderr[-400:]
+    assert all(x["value"] > 0 for x in recs)
+
+
 def test_dedup_both_emits_fastest_stream_first():
     """--dedup both must emit its stream records fastest-first (the
     supervisor headlines the FIRST SEPS record), with all three strategies
