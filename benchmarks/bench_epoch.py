@@ -46,7 +46,8 @@ def main():
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=47)
     p.add_argument("--cache-ratio", type=float, default=0.2)
-    p.add_argument("--model", default="sage", choices=["sage", "gat"])
+    p.add_argument("--model", default="sage",
+                   choices=["sage", "gat", "gcn"])
     p.add_argument(
         "--mode",
         default="HBM",
@@ -136,6 +137,11 @@ def _body(args):
         model = GAT(hidden=args.hidden, num_classes=args.classes,
                     num_layers=len(args.fanout), heads=args.heads,
                     dtype=dtype)
+    elif args.model == "gcn":
+        from quiver_tpu.models.gcn import GCN
+
+        model = GCN(hidden=args.hidden, num_classes=args.classes,
+                    num_layers=len(args.fanout), dtype=dtype)
     else:
         model = GraphSAGE(
             hidden=args.hidden, num_classes=args.classes,

@@ -6,9 +6,11 @@ full-neighbor layer-wise inference (the reference's ``model.inference``
 evaluation path, examples/pyg/reddit_quiver.py:68-92)."""
 
 from .gat import GAT
+from .gcn import GCN, GCNConv
 from .inference import (
     full_neighbor_mean,
     gat_layerwise_inference,
+    gcn_layerwise_inference,
     rgcn_layerwise_inference,
     sage_layerwise_inference,
 )
@@ -17,11 +19,14 @@ from .sage import GraphSAGE, SAGEConv
 
 __all__ = [
     "GAT",
+    "GCN",
+    "GCNConv",
     "GraphSAGE",
     "RGCN",
     "SAGEConv",
     "full_neighbor_mean",
     "gat_layerwise_inference",
+    "gcn_layerwise_inference",
     "rgcn_layerwise_inference",
     "sage_layerwise_inference",
 ]

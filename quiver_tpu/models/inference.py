@@ -28,12 +28,14 @@ from ..core.config import SampleMode
 from ..core.memory import to_pinned_host
 from ..ops.sample import staged_gather
 from .gat import GATConv
+from .gcn import GCNConv
 from .sage import SAGEConv
 
 __all__ = [
     "full_neighbor_mean",
     "sage_layerwise_inference",
     "gat_layerwise_inference",
+    "gcn_layerwise_inference",
     "rgcn_layerwise_inference",
 ]
 
@@ -203,6 +205,40 @@ def gat_layerwise_inference(model, params, topo, x_all,
         x = conv.apply(p_i, out, method=GATConv.finish)
         if not last:
             x = jax.nn.elu(x)
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def gcn_layerwise_inference(model, params, topo, x_all,
+                            chunk: int = 1 << 21,
+                            mode: str | SampleMode = SampleMode.HBM):
+    """Layer-wise full-neighbor GCN inference: symmetric-normalized
+    aggregation over the self-loop-augmented FULL graph,
+    ``D^-1/2 (A + I) D^-1/2 X`` per layer, with global degrees — exactly
+    what GCNConv computes on a block that covers the whole graph.
+
+    Reuses the chunked mean machinery: sum = mean · deg, with the feature
+    matrix pre-scaled by rsqrt(deg+1) and the result post-scaled the same
+    way (plus the self term). Assumes the usual undirected/symmetrized
+    topology (CSR row degree = both sides' degree), like full-graph GCN
+    itself; matches GCNConv exactly on such graphs.
+    """
+    x = jnp.asarray(x_all)
+    indptr, indices, host = _place(topo, mode)
+    deg = jnp.diff(indptr).astype(x.dtype)
+    inv_s = jax.lax.rsqrt(deg + 1.0)  # self-loop-augmented degrees
+    for i in range(model.num_layers):
+        feats = (
+            model.num_classes if i == model.num_layers - 1 else model.hidden
+        )
+        h = x * inv_s[:, None]
+        agg = _neighbor_mean_dev(indptr, indices, h, chunk, host)
+        agg = (agg * deg[:, None] + h) * inv_s[:, None]
+        conv = GCNConv(feats)
+        x = conv.apply(
+            {"params": params[f"conv{i}"]}, agg, method=GCNConv.combine
+        )
+        if i != model.num_layers - 1:
+            x = jax.nn.relu(x)
     return jax.nn.log_softmax(x, axis=-1)
 
 
