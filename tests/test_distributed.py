@@ -387,6 +387,39 @@ def test_data_parallel_epoch_smaller_than_global_batch():
     assert steps == 1 and np.isfinite(loss)
 
 
+def test_epoch_scan_gcn():
+    """The whole-epoch program must also serve the GCN family (in-block
+    symmetric normalization inside the scan body)."""
+    from quiver_tpu.models.gcn import GCN
+
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [5, 5], seed=3)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat[:n])
+    model = GCN(hidden=16, num_classes=4, num_layers=2)
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    idx = np.random.default_rng(1).integers(0, n, 4 * trainer.global_batch)
+    seed_mat = trainer.pack_epoch(idx, seed=0)
+    first = last = None
+    for e in range(3):
+        seed_mat = trainer.pack_epoch(idx, seed=e)
+        params, opt, losses = trainer.epoch_scan(
+            params, opt, seed_mat, labels_dev, jax.random.PRNGKey(e)
+        )
+        losses = np.asarray(losses)
+        assert np.all(np.isfinite(losses))
+        if first is None:
+            first = losses[0]
+        last = losses[-1]
+    assert last < first, (first, last)
+
+
 def test_epoch_scan_gat():
     """The whole-epoch program must also serve the GAT family (attention
     aggregation inside the scan body)."""
