@@ -158,8 +158,10 @@ def sampler_roofline(sampler, batch: int, dedup: str):
             # sequential memset + random scatter + random gather + write
             total += n_bound * 4 + 2 * T * GRANULE + caps[l] * 4
         elif dedup == "scan":
-            # three sorts + scans + gathers, all streaming: pure bytes
-            total += 3 * int(math.log2(max(T, 2))) * T * 8 + caps[l] * 4
+            # two sorts + scans + a binary-search compaction: pure bytes
+            # for the sorts, a granule per search probe
+            total += 2 * int(math.log2(max(T, 2))) * T * 8
+            total += int(math.log2(max(T, 2))) * caps[l] * GRANULE + caps[l] * 4
         else:
             # sort passes stream sequentially: pure bytes
             total += int(math.log2(max(T, 2))) * T * 8 + caps[l] * 4

@@ -82,10 +82,10 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
         node_bound from the id space that produced ``ids`` (the samplers
         pass topo.node_count; neighbor ids are CSR entries < node_count by
         construction).
-      scatter_free: use the ZERO-SCATTER strategy (``dedup="scan"``): three
-        sorts + a cumulative max + gathers, no ``.at[].set/min`` anywhere —
-        including the output compaction, which the other two strategies do
-        with a scatter. Rationale: the round-3 link characterization
+      scatter_free: use the ZERO-SCATTER strategy (``dedup="scan"``): two
+        sorts + a cumulative max + a binary-search compaction + gathers, no
+        ``.at[].set/min`` anywhere — the other two strategies compact their
+        output with a scatter. Rationale: the round-3 link characterization
         measured TPU sort at ~1.8 ms/M elements while the reindex stage ran
         tens of ms — XLA scatters with non-trivial index patterns can
         serialize on TPU, so a strategy whose only data movement is sorts,
@@ -154,14 +154,17 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
     num_unique = jnp.sum(is_rep.astype(jnp.int32))
 
     if scatter_free and node_bound is None:
-        # compaction by sort: reps first in ascending-position (=rank)
-        # order, everything else after — keys are distinct so no stability
-        # needed, and the (size,) write is a contiguous slice update, not
-        # a scatter
-        comp_order = jnp.argsort(jnp.where(is_rep, pos, T + pos))
+        # compaction WITHOUT a sort or scatter: ``rank`` is non-decreasing
+        # (a cumsum), and the r-th rep's position is the first index whose
+        # rank reaches r — a vectorized binary search. The (size,) write is
+        # a contiguous slice update.
         m = min(size, T)
+        comp_pos = jnp.searchsorted(
+            rank, jnp.arange(m, dtype=rank.dtype), side="left"
+        )
         packed = jnp.where(
-            jnp.arange(m) < num_unique, ids[comp_order[:m]], -1
+            jnp.arange(m) < num_unique,
+            ids[jnp.clip(comp_pos, 0, T - 1)], -1
         ).astype(ids.dtype)
         uniq = jnp.full(size, -1, ids.dtype).at[:m].set(packed)
     else:
