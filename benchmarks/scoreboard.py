@@ -32,9 +32,10 @@ JOBS = [
     # ordered: highest-evidence rows first, so a short chip window still
     # lands the headline stream/scan numbers before the long-tail jobs
     ("sampler-hbm", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--stages", "--stream", "128", "--dedup", "both"],
-     "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41); sort AND "
-     "dense-map dedup measured, fastest first"),
+     ["--mode", "HBM", "--stream", "128", "--dedup", "both"],
+     "ref 34.29M SEPS (1-GPU UVA, Introduction_en.md:41); sort, dense-map "
+     "AND scan dedup measured, fastest first (stage profile split into "
+     "its own job — one monolithic first job cost r4 a whole window)"),
     ("primitives", "benchmarks.microbench", [],
      "sort/scatter/gather/cummax Melem/s — decides which dedup strategy "
      "SHOULD win on this chip (scatter-serialization diagnosis), ~2 min"),
@@ -81,6 +82,9 @@ JOBS = [
      ["--scan-epoch", "--bf16", "--mode", "HOST", "--cache-ratio", "0.5"],
      "beyond-HBM FUSED: HOST topology + 50% cold tier through one "
      "compiled epoch program (r4; ref papers100M UVA path equivalent)"),
+    ("sampler-stages", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--stages", "--dedup", "both", "--iters", "8"],
+     "per-layer sample/reindex stage attribution for the headline row"),
     ("rgcn", "benchmarks.bench_rgcn", ["--stream", "16"],
      "no reference baseline (hetero is beyond-parity)"),
     ("infer-layerwise", "benchmarks.bench_infer", [],
@@ -205,9 +209,20 @@ def main():
         results.append({"key": key, "note": note, "records": recs,
                         "error": err, "seconds": round(dt, 1)})
 
-    os.makedirs(args.out, exist_ok=True)
-    json_path = os.path.join(args.out, "tpu_results.json")
-    if args.only and os.path.exists(json_path):
+    write_outputs(results, args.out, args.smoke, merge=bool(args.only))
+
+
+def write_outputs(results, out, smoke, merge=False):
+    """Write ``tpu_results.json`` + ``TPU_RESULTS.md`` from job results.
+
+    ``merge=True`` folds ``results`` into the existing json (keyed by job)
+    instead of replacing it — used by partial re-runs (``--only``) and by
+    the single-process chip-window runner (scripts/mega_session.py), which
+    writes after EVERY job so a mid-window kill loses nothing.
+    """
+    os.makedirs(out, exist_ok=True)
+    json_path = os.path.join(out, "tpu_results.json")
+    if merge and os.path.exists(json_path):
         # partial re-run: merge into the existing scoreboard instead of
         # wiping rows that weren't in the subset
         try:
@@ -224,14 +239,14 @@ def main():
         )
     stamp = datetime.datetime.now().isoformat(timespec="seconds")
     with open(json_path, "w") as fh:
-        json.dump({"when": stamp, "smoke": args.smoke, "jobs": results}, fh,
+        json.dump({"when": stamp, "smoke": smoke, "jobs": results}, fh,
                   indent=1)
 
     lines = [
         "# TPU scoreboard",
         "",
         f"Generated by `python -m benchmarks.scoreboard` at {stamp}"
-        + (" (SMOKE shapes)" if args.smoke else "") + ".",
+        + (" (SMOKE shapes)" if smoke else "") + ".",
         "",
         "| Job | Metric | Value | vs baseline | Platform | Reference point |",
         "|---|---|---|---|---|---|",
@@ -265,7 +280,7 @@ def main():
         "(value/baseline for throughput, baseline/value for times).",
         "",
     ]
-    with open(os.path.join(args.out, "TPU_RESULTS.md"), "w") as fh:
+    with open(os.path.join(out, "TPU_RESULTS.md"), "w") as fh:
         fh.write("\n".join(lines))
     print("\n".join(lines))
 

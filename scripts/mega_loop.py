@@ -1,0 +1,144 @@
+"""Outer watchdog for scripts/mega_session.py.
+
+The session holds one device grant and is not interruptible in-process when
+a device RPC wedges, so wall-budget enforcement lives here:
+
+* launch the session appending to a log;
+* if ``INIT_OK`` does not appear within ``--init-timeout``, the plugin is
+  blocked waiting for a grant — kill it (safe: no grant held) and retry
+  after a backoff;
+* once initialized, watch the ``START <key> budget=<s>`` / ``DONE <key>``
+  lines: a job over budget+grace means a wedged RPC — SIGINT, grace,
+  SIGTERM, then a longer backoff (the chip may need ~10 min to recover);
+* the session skips done jobs and abandons twice-wedged ones via its state
+  file, so restarts converge; exit when a session reports ALL DONE /
+  PASS COMPLETE, or when the total wall budget runs out.
+"""
+
+import argparse
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg):
+    print(f"[mega-loop] {time.strftime('%H:%M:%S')} {msg}", flush=True)
+
+
+def tail_lines(path, pos):
+    """New complete lines since byte offset pos -> (lines, new_pos)."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(pos)
+            chunk = fh.read()
+    except OSError:
+        return [], pos
+    if not chunk:
+        return [], pos
+    keep = chunk.rfind(b"\n")
+    if keep < 0:
+        return [], pos
+    lines = chunk[: keep + 1].decode("utf-8", "replace").splitlines()
+    return lines, pos + keep + 1
+
+
+def kill_tree(proc, grace=45):
+    try:
+        proc.send_signal(signal.SIGINT)
+    except OSError:
+        return
+    try:
+        proc.wait(grace)
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    try:
+        proc.terminate()
+        proc.wait(30)
+    except (OSError, subprocess.TimeoutExpired):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--log", default=os.path.join(REPO, "docs",
+                                                 "mega_session_r04.log"))
+    p.add_argument("--init-timeout", type=float, default=420)
+    p.add_argument("--grace", type=float, default=300,
+                   help="wall grace on top of each job's in-process budget")
+    p.add_argument("--retry-sleep", type=float, default=150)
+    p.add_argument("--wedge-sleep", type=float, default=300)
+    p.add_argument("--max-hours", type=float, default=9)
+    p.add_argument("--session-args", nargs=argparse.REMAINDER, default=[])
+    args = p.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        log(f"attempt {attempt}: launching mega_session")
+        logfh = open(args.log, "ab")
+        pos = logfh.seek(0, 2)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "scripts", "mega_session.py")]
+            + args.session_args,
+            stdout=logfh, stderr=subprocess.STDOUT, cwd=REPO,
+        )
+        t_start = time.time()
+        inited = False
+        job = None  # (key, budget, started_at)
+        outcome = None
+        while True:
+            rc = proc.poll()
+            lines, pos = tail_lines(args.log, pos)
+            for ln in lines:
+                if "INIT_OK" in ln:
+                    inited = True
+                    log(f"session initialized: {ln.strip()[-80:]}")
+                m = re.search(r"START (\S+) budget=(\d+)", ln)
+                if m:
+                    job = (m.group(1), float(m.group(2)), time.time())
+                if re.search(r"DONE \S+", ln):
+                    job = None
+                if "ALL DONE" in ln or "PASS COMPLETE" in ln:
+                    outcome = "complete"
+            if rc is not None:
+                if outcome != "complete":
+                    outcome = f"exited rc={rc}"
+                break
+            if not inited and time.time() - t_start > args.init_timeout:
+                log("no INIT_OK within budget — grant starved; killing "
+                    "(safe: no grant held)")
+                kill_tree(proc)
+                outcome = "init-timeout"
+                break
+            if job and time.time() - job[2] > job[1] + args.grace:
+                log(f"job {job[0]} exceeded {job[1]:.0f}s+{args.grace:.0f}s "
+                    "wall — wedged RPC; killing session")
+                kill_tree(proc)
+                outcome = "wedged"
+                break
+            time.sleep(15)
+        logfh.close()
+        log(f"attempt {attempt} outcome: {outcome}")
+        if outcome == "complete":
+            log("pass complete")
+            return 0
+        sleep = (args.wedge_sleep if outcome == "wedged"
+                 else args.retry_sleep)
+        log(f"sleeping {sleep:.0f}s before retry")
+        time.sleep(sleep)
+    log("wall budget exhausted")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

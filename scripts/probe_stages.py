@@ -1,0 +1,66 @@
+"""Step-timed TPU probe: prints wall time for each stage so a silent
+tunnel stall can be localized (backend init vs transfer vs compile vs run).
+
+Each stage prints BEFORE it starts (flushed), so a hang is attributable to
+the named stage even if the process never returns.
+"""
+
+import time
+import sys
+
+T0 = time.time()
+
+
+def mark(msg):
+    print(f"[probe +{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+mark("importing jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+mark("touching backend (jax.devices())")
+d = jax.devices()
+mark(f"devices: {d} platform={d[0].platform}")
+
+mark("tiny transfer (8 floats)")
+x = jnp.zeros(8)
+x.block_until_ready()
+mark("tiny transfer done")
+
+mark("tiny jit (x+1)")
+y = jax.jit(lambda a: a + 1)(x)
+y.block_until_ready()
+mark("tiny jit done")
+
+mark("1M-elem transfer")
+import numpy as np  # noqa: E402
+
+big = jnp.asarray(np.arange(1_000_000, dtype=np.int32))
+big.block_until_ready()
+mark("1M transfer done")
+
+mark("medium jit (sort 1M)")
+s = jax.jit(jnp.sort)(big)
+s.block_until_ready()
+mark("medium jit done")
+
+mark("D2H readback (1 scalar)")
+v = int(s[-1])
+mark(f"readback done ({v})")
+
+mark("medium jit 2 (argsort+cummax 1M)")
+
+
+@jax.jit
+def f(a):
+    o = jnp.argsort(a)
+    return jax.lax.cummax(a[o], axis=0)
+
+
+r = f(big)
+r.block_until_ready()
+mark("medium jit 2 done")
+
+mark("ALL OK")
+sys.exit(0)
