@@ -559,7 +559,13 @@ def build_graph(args):
             import numpy as np
 
             z = np.load(cache)
-            topo = CSRTopo(indptr=z["indptr"], indices=z["indices"])
+            if "eid" not in z.files:
+                # pre-eid-fix cache: both CSR builders always produce eid,
+                # so its absence means a stale file — regenerate, don't
+                # silently load an inequivalent topology
+                raise ValueError("stale cache (no eid)")
+            topo = CSRTopo(indptr=z["indptr"], indices=z["indices"],
+                           eid=z["eid"])
             log(f"graph: loaded CSR cache {os.path.basename(cache)}")
         except Exception as e:  # noqa: BLE001 — cache must never break a run
             log(f"graph cache load failed ({e}); regenerating")
@@ -575,8 +581,13 @@ def build_graph(args):
 
             os.makedirs(os.path.dirname(cache), exist_ok=True)
             tmp = cache + ".tmp"
+            arrays = {"indptr": topo.indptr, "indices": topo.indices}
+            if topo.eid is not None:
+                # equivalence: a cache hit must carry the same eid the
+                # COO build produced (with_eid consumers, HBM footprint)
+                arrays["eid"] = topo.eid
             with open(tmp, "wb") as fh:
-                np.savez(fh, indptr=topo.indptr, indices=topo.indices)
+                np.savez(fh, **arrays)
             os.replace(tmp, cache)
         except Exception as e:  # noqa: BLE001
             log(f"graph cache save failed ({e}); continuing uncached")

@@ -231,6 +231,14 @@ def write_outputs(results, out, smoke, merge=False):
         except (ValueError, KeyError):
             prior = {}
         for job in results:
+            old = prior.get(job["key"])
+            if old and old.get("records") and not job.get("records"):
+                # a failed re-run must not clobber earlier good evidence;
+                # keep the good row, note the newer failure on it
+                old = dict(old)
+                old["retry_error"] = job.get("error")
+                prior[job["key"]] = old
+                continue
             prior[job["key"]] = job
         order = [key for key, *_ in JOBS]
         results = sorted(
@@ -262,6 +270,12 @@ def write_outputs(results, out, smoke, merge=False):
             plat = rec.get("platform", "?")
             if rec.get("degraded"):
                 plat += " (degraded)"
+            if rec.get("smoke"):
+                # per-record stamp so merged tables can mix full-scale and
+                # smoke rows without the header mislabeling either
+                plat += " (smoke)"
+            if job.get("retry_error"):
+                plat += " [kept: newer retry failed]"
             metric = rec.get("metric", "?")
             extras = {k: v for k, v in rec.items()
                       if k in ("kernel", "mode", "policy", "caps", "sampler",

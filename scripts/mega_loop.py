@@ -126,12 +126,19 @@ def main():
                 kill_tree(proc)
                 outcome = "wedged"
                 break
+            if time.time() > deadline:
+                log("wall budget exhausted mid-session; stopping it")
+                kill_tree(proc)
+                outcome = "deadline"
+                break
             time.sleep(15)
         logfh.close()
         log(f"attempt {attempt} outcome: {outcome}")
         if outcome == "complete":
             log("pass complete")
             return 0
+        if outcome == "deadline":
+            break
         sleep = (args.wedge_sleep if outcome == "wedged"
                  else args.retry_sleep)
         log(f"sleeping {sleep:.0f}s before retry")

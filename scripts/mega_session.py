@@ -45,52 +45,61 @@ def mark(msg):
     print(f"[mega +{time.time() - T0:7.1f}s] {msg}", flush=True)
 
 
-# (key, module, argv, budget_s) — evidence-ordered: the headline SEPS and
-# GB/s rows land inside the first ~30 minutes of a window.
-JOBS = [
-    ("primitives", "benchmarks.microbench", [], 600),
-    ("sampler-hbm", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--stream", "128", "--dedup", "both"], 1800),
-    ("feature-replicate", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--stream", "32"], 1200),
-    ("epoch-scan", "benchmarks.bench_epoch",
-     ["--scan-epoch", "--bf16", "--cache-ratio", "1.0"], 1800),
-    ("validation", "benchmarks.tpu_validation", [], 1200),
-    ("sampler-pallas", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"], 1200),
-    ("sampler-host", "benchmarks.bench_sampler",
-     ["--mode", "HOST", "--stream", "128"], 1200),
-    ("feature-replicate-xla", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--kernel", "xla", "--stream", "32"], 900),
-    ("feature-bf16", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--dtype", "bf16", "--stream", "32"], 900),
-    ("feature-int8", "benchmarks.bench_feature",
-     ["--policy", "replicate", "--dtype", "int8", "--stream", "32"], 900),
-    ("epoch-scan-host", "benchmarks.bench_epoch",
-     ["--scan-epoch", "--bf16", "--mode", "HOST", "--cache-ratio", "0.5"],
-     1500),
-    ("sampler-weighted", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--weighted", "--stream", "128", "--dedup", "both"],
-     1500),
-    ("epoch-fused-bf16", "benchmarks.bench_epoch",
-     ["--fused", "--bf16", "--cache-ratio", "1.0"], 1200),
-    ("epoch-hbm", "benchmarks.bench_epoch", ["--mode", "HBM"], 1200),
-    ("epoch-bf16", "benchmarks.bench_epoch", ["--mode", "HBM", "--bf16"],
-     1200),
-    ("epoch-fused", "benchmarks.bench_epoch",
-     ["--fused", "--cache-ratio", "1.0"], 1200),
-    ("epoch-host", "benchmarks.bench_epoch", ["--mode", "HOST"], 1200),
-    ("sampler-stages", "benchmarks.bench_sampler",
-     ["--mode", "HBM", "--stages", "--dedup", "both", "--iters", "8"], 1500),
-    ("rgcn", "benchmarks.bench_rgcn", ["--stream", "16"], 900),
-    ("infer-layerwise", "benchmarks.bench_infer", [], 900),
-    ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"], 900),
-    ("feature-shard-routed", "benchmarks.bench_feature",
-     ["--policy", "shard", "--routed", "--stream", "32"], 900),
-    ("acceptance", "examples.train_sage",
-     ["--dataset", "planted:50000", "--epochs", "3"], 1800),
-    ("sweep", "benchmarks.sweep_sampler", ["--stream", "64"], 2400),
+# Evidence order + per-job in-process budgets; module/argv/note come from
+# benchmarks.scoreboard.JOBS (single source of truth — r4 review finding:
+# two hand-maintained 24-entry tables WILL drift). The two non-scoreboard
+# jobs (acceptance, sweep) are defined in EXTRA_JOBS.
+ORDER = [
+    ("primitives", 600),
+    ("sampler-hbm", 1800),
+    ("feature-replicate", 1200),
+    ("epoch-scan", 1800),
+    ("validation", 1200),
+    ("sampler-pallas", 1200),
+    ("sampler-host", 1200),
+    ("feature-replicate-xla", 900),
+    ("feature-bf16", 900),
+    ("feature-int8", 900),
+    ("epoch-scan-host", 1500),
+    ("sampler-weighted", 1500),
+    ("epoch-fused-bf16", 1200),
+    ("epoch-hbm", 1200),
+    ("epoch-bf16", 1200),
+    ("epoch-fused", 1200),
+    ("epoch-host", 1200),
+    ("sampler-stages", 1500),
+    ("rgcn", 900),
+    ("infer-layerwise", 900),
+    ("saint-node", 900),
+    ("feature-shard-routed", 900),
+    ("acceptance", 1800),
+    ("sweep", 2400),
 ]
+
+EXTRA_JOBS = {
+    "acceptance": ("examples.train_sage",
+                   ["--dataset", "planted:50000", "--epochs", "3"]),
+    "sweep": ("benchmarks.sweep_sampler", ["--stream", "64"]),
+}
+
+
+def job_table():
+    """(key, module, argv, budget) in ORDER, sourced from scoreboard.JOBS."""
+    from benchmarks import scoreboard
+
+    by_key = {key: (mod, argv) for key, mod, argv, _n in scoreboard.JOBS}
+    by_key.update(EXTRA_JOBS)
+    ordered = {k for k, _b in ORDER}
+    missing = [k for k, _b in ORDER if k not in by_key]
+    if missing:
+        raise SystemExit(f"ORDER keys missing from scoreboard.JOBS: {missing}")
+    unordered = [k for k in by_key if k not in ordered]
+    if unordered:
+        # both directions fail loudly: a job added to the scoreboard but
+        # not given a budget/slot here would silently skip chip windows
+        raise SystemExit(f"scoreboard.JOBS keys missing from ORDER: "
+                         f"{sorted(unordered)}")
+    return [(k, by_key[k][0], list(by_key[k][1]), b) for k, b in ORDER]
 
 # jobs whose records feed the scoreboard table (acceptance/sweep log-only)
 TABLE_EXCLUDE = {"acceptance", "sweep"}
@@ -168,10 +177,15 @@ def main():
 
     _enable_compilation_cache()
 
+    jobs = job_table()
+    if args.only:
+        unknown = set(args.only) - {k for k, *_ in jobs}
+        if unknown:
+            p.error(f"unknown job keys: {sorted(unknown)}")
     state = load_state(args.state)
     done = set(state["done"])
     todo = []
-    for key, module, argv, budget in JOBS:
+    for key, module, argv, budget in jobs:
         if args.only and key not in args.only:
             continue
         if key in done:
@@ -244,7 +258,9 @@ def main():
             signal.alarm(budget)
             mod = importlib.import_module(module)
             rc = mod.main()
-            if rc not in (None, 0):
+            # only an integer return is an exit status (train_sage returns
+            # its (accuracy, dataset) result tuple — that is success)
+            if isinstance(rc, int) and rc != 0:
                 err = f"rc={rc}"
         except JobTimeout:
             err = f"in-process budget {budget}s exceeded"
@@ -265,7 +281,9 @@ def main():
 
         recs = _harvest(tee.buf.getvalue())
         dt = time.time() - t0
-        if recs and not err:
+        # acceptance is the only truly log-only job; sweep swallows
+        # per-config errors and can return empty — keep it retryable then
+        if not err and (recs or key == "acceptance"):
             state["done"].append(key)
             save_state(args.state, state)
         mark(f"DONE {key}: {len(recs)} records in {dt:.0f}s"
@@ -273,13 +291,13 @@ def main():
         if key not in TABLE_EXCLUDE:
             job_result = {"key": key, "note": notes.get(key, ""),
                           "records": recs, "error": err,
-                          "seconds": round(dt, 1)}
+                          "seconds": round(dt, 1), "smoke": args.smoke}
             try:
                 import contextlib
 
                 with contextlib.redirect_stdout(io.StringIO()):
                     scoreboard.write_outputs([job_result], args.out,
-                                             smoke=False, merge=True)
+                                             smoke=args.smoke, merge=True)
             except Exception as e:  # noqa: BLE001
                 mark(f"scoreboard write failed: {e}")
 
