@@ -31,12 +31,20 @@ import subprocess
 import sys
 import time
 
-CHILD = ["-m", "benchmarks.bench_sampler", "--stages", "--stream", "128",
+# lean headline: the three-way dedup self-selection WITHOUT the --stages
+# attribution phase (that is the scoreboard's sampler-stages job now) — the
+# r4 window lesson is that one monolithic first job risks the whole budget
+CHILD = ["-m", "benchmarks.bench_sampler", "--stream", "128",
          "--dedup", "both"]
 # one real-chip attempt budget: first jit compile alone is 20-40s; the
 # products-scale graph build is ~10s; 50 measured iters a few seconds.
 ATTEMPT_TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1500))
 PROBE_TIMEOUT = float(os.environ.get("QUIVER_BENCH_PROBE_TIMEOUT", 240))
+# grant starvation guard: the plugin blocks FOREVER at backend init when
+# the tunnel serves no grant (r4: a 30-min attempt budget burned entirely
+# at init). If the child hasn't logged "backend ok" within this window,
+# kill it — a process blocked at init holds no grant, so this is safe.
+INIT_TIMEOUT = float(os.environ.get("QUIVER_BENCH_INIT_TIMEOUT", 300))
 RETRY_DELAY = float(os.environ.get("QUIVER_BENCH_RETRY_DELAY", 30))
 SETTLE_S = float(os.environ.get("QUIVER_BENCH_SETTLE", 5))
 
@@ -115,8 +123,17 @@ def _split_records(text: str):
     return recs[-1], recs[:-1]
 
 
-def _attempt(extra_args, env_overrides, timeout_s, label):
-    """Run the measured child once. Returns (record|None, error, hung)."""
+def _attempt(extra_args, env_overrides, timeout_s, label, init_timeout=None):
+    """Run the measured child once. Returns (record|None, error, hung).
+
+    ``init_timeout``: if set, the child must log "backend ok" (its
+    init_backend marker) within that window or it is killed — a child
+    blocked at backend init holds no grant, so killing it is safe and
+    turns a silent grant-starved stall into a fast, labeled failure.
+    """
+    import shutil
+    import tempfile
+
     env = _env(env_overrides)
     # the child is watchdogged HERE: it must skip its own subprocess probe
     # (slow, and briefly holds the single chip right before the child's
@@ -126,22 +143,71 @@ def _attempt(extra_args, env_overrides, timeout_s, label):
     argv = [sys.executable] + CHILD + extra_args + sys.argv[1:]
     _log(f"{label}: {' '.join(argv[1:])}")
     t0 = time.time()
+    # child output goes to named files; the parent reads through SEPARATE
+    # handles — handing the parent's own handle to Popen would share one
+    # file description, so a parent seek would move the child's write
+    # offset and clobber its output mid-run
+    tmpdir = tempfile.mkdtemp(prefix="bench_attempt_")
+    out_path = os.path.join(tmpdir, "out")
+    err_path = os.path.join(tmpdir, "err")
+    marker = b"backend ok"
     try:
-        r = subprocess.run(
-            argv, capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=repo_root,
-        )
-    except subprocess.TimeoutExpired as e:
-        tail = e.stderr or ""
-        out = e.stdout or ""
-        if isinstance(tail, bytes):
-            tail = tail.decode("utf-8", "replace")
-        if isinstance(out, bytes):
-            out = out.decode("utf-8", "replace")
-        sys.stderr.write(tail[-2000:])
+        with open(out_path, "wb") as child_out, \
+                open(err_path, "wb") as child_err:
+            proc = subprocess.Popen(argv, stdout=child_out, stderr=child_err,
+                                    env=env, cwd=repo_root)
+        inited = init_timeout is None
+        timed_out = starved = False
+        seen = 0
+        tail = b""
+        try:
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                el = time.time() - t0
+                if not inited:
+                    # incremental read; keep a marker-sized overlap so a
+                    # marker split across two reads still matches
+                    with open(err_path, "rb") as fh:
+                        fh.seek(seen)
+                        chunk = fh.read()
+                    seen += len(chunk)
+                    if marker in tail + chunk:
+                        inited = True
+                    else:
+                        tail = (tail + chunk)[-(len(marker) - 1):]
+                    if not inited and el > init_timeout:
+                        starved = True
+                        break
+                if el > timeout_s:
+                    timed_out = True
+                    break
+                time.sleep(5)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            proc.wait()  # always reap
+        with open(out_path, "rb") as fh:
+            out = fh.read().decode("utf-8", "replace")
+        with open(err_path, "rb") as fh:
+            errtext = fh.read().decode("utf-8", "replace")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    if starved:
+        sys.stderr.write(errtext[-2000:])
+        _log(f"{label}: no backend init within {init_timeout:.0f}s — "
+             "grant starved (killed; no grant was held)")
+        return None, f"backend init starved > {init_timeout:.0f}s", False
+    if timed_out:
+        sys.stderr.write(errtext[-2000:])
         # the child may have emitted the headline BEFORE hanging (e.g. in
-        # the optional --stages phase) — a measured number must never be
-        # discarded because a secondary phase overran the watchdog
+        # a secondary phase) — a measured number must never be discarded
+        # because a later phase overran the watchdog
         rec, extras = _split_records(out)
         if rec is not None:
             for x in extras:
@@ -151,19 +217,19 @@ def _attempt(extra_args, env_overrides, timeout_s, label):
             return rec, None, False
         _log(f"{label}: hung > {timeout_s:.0f}s (killed)")
         return None, f"timeout>{timeout_s:.0f}s", True
-    sys.stderr.write(r.stderr[-4000:])
-    rec, extras = _split_records(r.stdout)
+    sys.stderr.write(errtext[-4000:])
+    rec, extras = _split_records(out)
     dt = time.time() - t0
     if rec is not None:
-        # secondary records (--stages attribution rows) ride in stderr so
+        # secondary records (extra dedup-strategy rows) ride in stderr so
         # the driver's tail log keeps them without disturbing the one-line
         # stdout contract
         for x in extras:
             _log(f"extra: {json.dumps(x)}")
         _log(f"{label}: ok in {dt:.0f}s")
         return rec, None, False
-    err = (r.stderr or r.stdout).strip()[-600:] or f"rc={r.returncode}, no output"
-    _log(f"{label}: failed rc={r.returncode} in {dt:.0f}s")
+    err = (errtext or out).strip()[-600:] or f"rc={proc.returncode}, no output"
+    _log(f"{label}: failed rc={proc.returncode} in {dt:.0f}s")
     return None, err, False
 
 
@@ -209,7 +275,8 @@ def main():
             continue
         time.sleep(SETTLE_S)  # let the probe's chip hold fully release
         rec, err, hung = _attempt([], {}, ATTEMPT_TIMEOUT,
-                                  f"attempt {n} (default backend)")
+                                  f"attempt {n} (default backend)",
+                                  init_timeout=INIT_TIMEOUT)
         if rec is not None:
             print(json.dumps(rec), flush=True)
             return 0
