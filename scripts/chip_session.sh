@@ -1,83 +1,38 @@
 #!/bin/bash
-# Full chip session: probes the tunneled TPU until it answers, then runs
-# the complete on-hardware evidence pass, HIGHEST-VALUE FIRST so a short
-# window still lands the headline (r3 lesson: 90 usable minutes produced
-# one headline and zero scoreboard rows because the long jobs ran first):
-#   1. headline    -> repo-root bench.py (dedup self-selection, stream SEPS;
-#                     every TPU record also lands in docs/tpu_ledger.jsonl)
-#   2. scoreboard  -> docs/TPU_RESULTS.md platform=tpu rows (jobs are
-#                     themselves evidence-ordered; per-job budget below)
-#   3. acceptance  -> planted-SBM training on-device
-#   4. sweep       -> dedup x batch stream SEPS grid (longest; last)
+# Chip-window evidence pass. Since round 4 this is a thin wrapper over the
+# single-grant runner:
 #
-# Kill discipline (docs/TPU_MEASUREMENTS_R3.md): a SIGKILLed TPU process
-# wedges the chip ~10+ minutes. Budgets are IN-PROCESS where the harness
-# has them (bench.py / scoreboard supervise their own children); the two
-# bare jobs get `timeout -s INT` + a 60s grace so python unwinds instead
-# of dying mid-grant — and even that SIGINT can wedge; budgets are sized
-# so they fire only when the tunnel is already gone.
+#   scripts/mega_session.py  — ONE process, ONE device grant, every
+#       benchmark run in-process in evidence order: primitives first (a
+#       2-minute small-compile job proving grants+compiles flow before
+#       anything big), then sampler-hbm — which IS the headline (the exact
+#       bench.py child config: stream 128, --dedup both); its records land
+#       in docs/tpu_ledger.jsonl, which the driver's round-end bench.py
+#       re-emits. Per-job budgets + state; results merged into
+#       docs/TPU_RESULTS.md and the ledger after every job.
+#   scripts/mega_loop.py     — outer watchdog: kills a session that can't
+#       init (grant starvation: the plugin blocks forever and holds no
+#       grant, so the kill is safe) and one whose job wedges, retries with
+#       backoff until the pass completes or the wall budget runs out.
 #
-# Rehearsal (VERDICT r3 item 7): CHIP_SESSION_REHEARSE=1 skips the probe
-# loop and runs the whole pass forced-CPU at smoke scale — proves the
-# runner end-to-end so chip minutes are spent measuring, not debugging.
+# WHY (r4 window postmortem): every process needs its own grant from the
+# tunnel; grants stall silently for 10+ minutes; the old probe-then-
+# subprocess-per-job design burned a 30-minute headline budget entirely
+# BLOCKED AT INIT, then queued 20 more jobs behind the same stall. One
+# grant amortized across the whole pass + an init watchdog is the fix
+# rehearsed and used in round 4.
+#
+# Rehearsal: CHIP_SESSION_REHEARSE=1 runs the whole pass forced-CPU at
+# smoke scale into docs/rehearsal/ (cannot clobber TPU evidence).
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
-ROUND="${ROUND:-r04}"
-log(){ echo "[chip-session] $(date -u +%H:%M:%S) $*"; }
-
-run_pass(){
-  local smoke="$1"
-  local sb_out="$2"
-  log "=== 1. headline (bench.py) ==="
-  QUIVER_BENCH_TIMEOUT="${QUIVER_BENCH_TIMEOUT:-1800}" \
-    python bench.py $smoke > "docs/headline_${ROUND}.log" 2>&1
-  log "headline rc=$? (docs/headline_${ROUND}.log)"
-  grep -h '^{' "docs/headline_${ROUND}.log" | head -2
-
-  log "=== 2. scoreboard ==="
-  QUIVER_BENCH_TIMEOUT="${QUIVER_BENCH_TIMEOUT:-2400}" \
-    python -m benchmarks.scoreboard $smoke $sb_out
-  log "scoreboard rc=$? (${sb_out:-docs}/TPU_RESULTS.md)"
-
-  log "=== 3. acceptance training (planted SBM) ==="
-  timeout -s INT -k 60 2400 python -m examples.train_sage \
-    --dataset "planted:${ACCEPT_NODES:-50000}" --epochs 3 \
-    > "docs/acceptance_tpu_${ROUND}.log" 2>&1
-  log "acceptance rc=$? (docs/acceptance_tpu_${ROUND}.log)"
-
-  log "=== 4. sweep ==="
-  QUIVER_BENCH_SUPERVISED=1 timeout -s INT -k 60 3600 \
-    python -m benchmarks.sweep_sampler --stream "${SWEEP_STREAM:-64}" $smoke \
-    > "docs/sweep_${ROUND}.log" 2>&1
-  log "sweep rc=$? (docs/sweep_${ROUND}.log)"
-  log "pass done"
-}
 
 if [ "${CHIP_SESSION_REHEARSE:-0}" = "1" ]; then
-  log "REHEARSAL: forced-CPU smoke pass (no probe loop)"
-  export JAX_PLATFORMS=cpu
-  export QUIVER_BENCH_TIMEOUT="${QUIVER_BENCH_TIMEOUT:-600}"
-  export ACCEPT_NODES="${ACCEPT_NODES:-20000}"
-  export SWEEP_STREAM=8
-  ROUND="${ROUND}-rehearsal"
-  # --out keeps rehearsal CPU rows from clobbering the real TPU scoreboard
-  run_pass "--smoke" "--out docs/rehearsal"
-  exit 0
+  rm -f /tmp/mega_rehearsal_state.json
+  JAX_PLATFORMS=cpu exec python scripts/mega_session.py \
+    --allow-cpu --smoke \
+    --state /tmp/mega_rehearsal_state.json --out docs/rehearsal
 fi
 
-for i in $(seq 1 "${CHIP_SESSION_PROBES:-400}"); do
-  if timeout 240 python -c "
-import jax, jax.numpy as jnp
-jnp.zeros(8).block_until_ready()
-assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
-    log "chip answered on probe $i"
-    sleep 10
-    run_pass "" ""
-    exit 0
-  fi
-  log "probe $i failed; sleeping 150s"
-  sleep 150
-done
-log "gave up"
-exit 1
+exec python scripts/mega_loop.py --max-hours "${CHIP_SESSION_HOURS:-8}"

@@ -1,0 +1,158 @@
+"""Chip-window runner harness: graph cache equivalence, scoreboard merge
+discipline, and job-table drift checks (round-4 window postmortem).
+
+The contracts under test:
+
+* a ``build_graph`` cache hit is EQUIVALENT to a fresh build (same indptr/
+  indices/eid), and a stale pre-eid cache file is regenerated, not loaded;
+* ``scoreboard.write_outputs(merge=True)`` never lets a failed re-run
+  clobber a prior good row, and labels kept/smoke rows in the table;
+* ``mega_session.job_table()`` fails loudly on drift between its ORDER
+  list and ``scoreboard.JOBS`` in BOTH directions.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from benchmarks import common, scoreboard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _args(nodes=5000, deg=8.0, seed=3):
+    return argparse.Namespace(
+        nodes=nodes, avg_degree=deg, seed=seed, smoke=False,
+        backend_retries=0, backend_retry_delay=0.1,
+    )
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    # supervised mode: init_backend touches the (conftest-forced CPU)
+    # backend directly instead of spawning a probe subprocess that would
+    # block on the image's pinned TPU plugin
+    monkeypatch.setenv("QUIVER_BENCH_SUPERVISED", "1")
+    monkeypatch.setattr(
+        common, "_graph_cache_path",
+        lambda nodes, avg_degree, seed: str(
+            tmp_path / f"pareto_n{nodes}_d{avg_degree:g}_s{seed}.npz"),
+    )
+    return tmp_path
+
+
+class TestGraphCache:
+    def test_hit_is_equivalent_to_fresh_build(self, cache_dir):
+        fresh = common.build_graph(_args())
+        files = list(cache_dir.glob("*.npz"))
+        assert len(files) == 1
+        cached = common.build_graph(_args())
+        np.testing.assert_array_equal(fresh.indptr, cached.indptr)
+        np.testing.assert_array_equal(fresh.indices, cached.indices)
+        assert fresh.eid is not None and cached.eid is not None
+        np.testing.assert_array_equal(fresh.eid, cached.eid)
+
+    def test_stale_no_eid_cache_regenerates(self, cache_dir):
+        fresh = common.build_graph(_args())
+        path = next(cache_dir.glob("*.npz"))
+        with open(path, "wb") as fh:
+            np.savez(fh, indptr=fresh.indptr, indices=fresh.indices)
+        again = common.build_graph(_args())
+        assert again.eid is not None
+        np.testing.assert_array_equal(fresh.eid, again.eid)
+        # and the stale file was replaced with a complete one
+        assert "eid" in np.load(path).files
+
+    def test_corrupt_cache_regenerates(self, cache_dir):
+        common.build_graph(_args())
+        path = next(cache_dir.glob("*.npz"))
+        path.write_bytes(b"not an npz")
+        topo = common.build_graph(_args())
+        assert topo.node_count == 5000
+
+
+def _job(key, value=1.0, error=None, smoke=False, records=None):
+    if records is None:
+        records = [] if error else [
+            {"metric": "m", "value": value, "unit": "u", "vs_baseline": None,
+             "platform": "tpu", **({"smoke": True} if smoke else {})}
+        ]
+    return {"key": key, "note": "n", "records": records, "error": error,
+            "seconds": 1.0, "smoke": smoke}
+
+
+class TestScoreboardMerge:
+    def test_failed_rerun_keeps_prior_good_row(self, tmp_path, capsys):
+        scoreboard.write_outputs([_job("sampler-hbm", 5.0)], str(tmp_path),
+                                 smoke=False)
+        scoreboard.write_outputs([_job("sampler-hbm", error="timeout>1s")],
+                                 str(tmp_path), smoke=False, merge=True)
+        data = json.loads((tmp_path / "tpu_results.json").read_text())
+        jobs = {j["key"]: j for j in data["jobs"]}
+        assert jobs["sampler-hbm"]["records"][0]["value"] == 5.0
+        assert jobs["sampler-hbm"]["retry_error"] == "timeout>1s"
+        md = (tmp_path / "TPU_RESULTS.md").read_text()
+        assert "kept: newer retry failed" in md
+
+    def test_good_rerun_replaces_prior(self, tmp_path, capsys):
+        scoreboard.write_outputs([_job("sampler-hbm", 5.0)], str(tmp_path),
+                                 smoke=False)
+        scoreboard.write_outputs([_job("sampler-hbm", 9.0)], str(tmp_path),
+                                 smoke=False, merge=True)
+        data = json.loads((tmp_path / "tpu_results.json").read_text())
+        jobs = {j["key"]: j for j in data["jobs"]}
+        assert jobs["sampler-hbm"]["records"][0]["value"] == 9.0
+        assert "retry_error" not in jobs["sampler-hbm"]
+
+    def test_smoke_records_labeled_in_table(self, tmp_path, capsys):
+        scoreboard.write_outputs([_job("sampler-hbm", 5.0, smoke=True)],
+                                 str(tmp_path), smoke=True)
+        md = (tmp_path / "TPU_RESULTS.md").read_text()
+        assert "(smoke)" in md
+
+
+def _load_mega_session():
+    # the module sets QUIVER_BENCH_SUPERVISED and prepends to sys.path at
+    # import time (it is a script, not a library) — keep both out of the
+    # rest of the pytest session
+    import sys
+
+    env_before = os.environ.get("QUIVER_BENCH_SUPERVISED")
+    path_before = list(sys.path)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "mega_session", os.path.join(REPO, "scripts", "mega_session.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path[:] = path_before
+        if env_before is None:
+            os.environ.pop("QUIVER_BENCH_SUPERVISED", None)
+        else:
+            os.environ["QUIVER_BENCH_SUPERVISED"] = env_before
+    return mod
+
+
+class TestJobTableDrift:
+    def test_table_covers_scoreboard_jobs(self):
+        ms = _load_mega_session()
+        table = ms.job_table()
+        keys = [k for k, *_ in table]
+        assert len(keys) == len(set(keys))
+        assert set(k for k, *_ in scoreboard.JOBS) <= set(keys)
+
+    def test_both_drift_directions_raise(self, monkeypatch):
+        ms = _load_mega_session()
+        with monkeypatch.context() as m:
+            m.setattr(ms, "ORDER", ms.ORDER + [("brand-new-job", 100)])
+            with pytest.raises(SystemExit, match="missing from scoreboard"):
+                ms.job_table()
+        with monkeypatch.context() as m:
+            m.setattr(scoreboard, "JOBS", scoreboard.JOBS + [
+                ("unordered-job", "benchmarks.microbench", [], "note")])
+            with pytest.raises(SystemExit, match="missing from ORDER"):
+                ms.job_table()
