@@ -195,6 +195,11 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
     one_rep()  # compile
     log(f"stream compile: {time.time()-t0:.1f}s ({args.stream} batches/scan)")
     gbps = float(np.median([one_rep() for _ in range(reps)]))
+    extras = {}
+    ceiling = _gather_ceiling_gbps(args, store, stored_itemsize, row_overhead)
+    if ceiling is not None:
+        extras = {"roofline_frac": round(gbps / ceiling, 3),
+                  "ceiling_gbps": round(ceiling, 1)}
     emit(
         "feature-collection-GBps/chip",
         gbps,
@@ -208,7 +213,37 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
         dispatch="stream",
         stream_batches=args.stream,
         routed=getattr(args, "routed", False),
+        **extras,
     )
+
+
+def _gather_ceiling_gbps(args, store, stored_itemsize, row_overhead):
+    """HBM-traffic ceiling for the row gather, in COUNTED GB/s (counted
+    bytes = stored row bytes, the number the headline reports).
+
+    Per gathered row the chip must move: one 32-byte granule for the random
+    row-start access, the stored row (contiguous read), the OUTPUT row
+    write (f32-dequantized for int8 — 4 bytes/element regardless of the
+    stored tier), and for int8 a granule for the per-row scale gather.
+    Only meaningful when every row lives in this chip's HBM: with a cold
+    tier the bound is the host link, and with a sharded table it is the
+    ICI collective path — a made-up ceiling would flatter those numbers,
+    so both cases emit none.
+    """
+    from benchmarks.common import hbm_bandwidth_gbps
+
+    if store.cache_ratio < 1.0 or args.policy != "replicate":
+        return None
+    bw = hbm_bandwidth_gbps()
+    if bw is None:
+        return None
+    dim = store.shape[1]
+    stored_row = dim * stored_itemsize + row_overhead
+    out_itemsize = 4 if args.dtype == "int8" else stored_itemsize
+    traffic = 32 + stored_row + dim * out_itemsize
+    if args.dtype == "int8":
+        traffic += 32  # random access to the f32 dequant scale row
+    return bw * stored_row / traffic
 
 
 if __name__ == "__main__":
