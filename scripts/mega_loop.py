@@ -47,6 +47,39 @@ def tail_lines(path, pos):
     return lines, pos + keep + 1
 
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "jnp.zeros(8).block_until_ready();"
+    "print(jax.devices()[0].platform, flush=True)"
+)
+
+
+def start_probe():
+    """Launch a fresh-process grant probe, non-blocking.
+
+    Used only to disambiguate patient-mode stalls: grants flowing while
+    the session stays blocked at init means the session's pending request
+    was dropped server-side and a relaunch will succeed immediately.
+    """
+    return subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def finish_probe(proc):
+    """(ok, detail) for an EXITED probe. ok requires a real TPU platform —
+    a CPU-fallback init is not a grant (mega_session rejects it too)."""
+    try:
+        out, err = proc.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return False, "probe unreapable"
+    if proc.returncode == 0 and "tpu" in (out or ""):
+        return True, out.strip()
+    return False, ((err or out or "").strip()[-200:]
+                   or f"rc={proc.returncode}")
+
+
 def kill_tree(proc, grace=45):
     try:
         proc.send_signal(signal.SIGINT)
@@ -71,14 +104,31 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--log", default=os.path.join(REPO, "docs",
                                                  "mega_session_r04.log"))
-    p.add_argument("--init-timeout", type=float, default=420)
+    # patient defaults (r4 window postmortem): a session blocked at init
+    # holds no grant but DOES hold a place in the tunnel's queue; killing
+    # waiting clients correlates with perpetual starvation, so the init
+    # window is hours, with side probes to catch dead pending requests
+    p.add_argument("--init-timeout", type=float, default=7200)
     p.add_argument("--grace", type=float, default=300,
                    help="wall grace on top of each job's in-process budget")
-    p.add_argument("--retry-sleep", type=float, default=150)
+    p.add_argument("--retry-sleep", type=float, default=600)
     p.add_argument("--wedge-sleep", type=float, default=300)
     p.add_argument("--max-hours", type=float, default=9)
+    p.add_argument("--probe-after", type=float, default=900,
+                   help="side-probe the tunnel once the session has been "
+                        "stuck at init this long (0 disables)")
+    p.add_argument("--probe-interval", type=float, default=600)
+    p.add_argument("--probe-timeout", type=float, default=120)
+    p.add_argument("--probe-confirm", type=float, default=180,
+                   help="after a SUCCESSFUL side probe, give the stuck "
+                        "session this long to initialize before declaring "
+                        "its pending grant request dead and relaunching")
     p.add_argument("--session-args", nargs=argparse.REMAINDER, default=[])
     args = p.parse_args()
+
+    if args.probe_after and args.probe_after >= args.init_timeout:
+        log(f"note: --probe-after {args.probe_after:.0f} >= --init-timeout "
+            f"{args.init_timeout:.0f}; side probes will never fire")
 
     deadline = time.time() + args.max_hours * 3600
     attempt = 0
@@ -96,6 +146,9 @@ def main():
         inited = False
         job = None  # (key, budget, started_at)
         outcome = None
+        last_probe = probe_t0 = 0.0
+        probe_ok_at = None
+        probe = None
         while True:
             rc = proc.poll()
             lines, pos = tail_lines(args.log, pos)
@@ -120,6 +173,33 @@ def main():
                 kill_tree(proc)
                 outcome = "init-timeout"
                 break
+            if (not inited and args.probe_after
+                    and time.time() - t_start > args.probe_after):
+                if probe_ok_at and time.time() - probe_ok_at > args.probe_confirm:
+                    log("grants flow (side probe ok) but the session is "
+                        "still blocked — its pending request is dead; "
+                        "relaunching now")
+                    kill_tree(proc)
+                    outcome = "stale-pending"
+                    break
+                if probe is not None:
+                    # reap or time out the in-flight probe WITHOUT blocking
+                    # the monitor; never SIGKILL a grant-waiting client
+                    # (the r3/r4 wedge pattern) — kill_tree INTs first
+                    if probe.poll() is not None:
+                        ok, detail = finish_probe(probe)
+                        log(f"side probe: {'ok ' + detail if ok else detail}")
+                        if ok:
+                            probe_ok_at = time.time()
+                        probe = None
+                    elif time.time() - probe_t0 > args.probe_timeout:
+                        log(f"side probe starved > {args.probe_timeout:.0f}s")
+                        kill_tree(probe, grace=15)
+                        probe = None
+                elif (not probe_ok_at
+                        and time.time() - last_probe > args.probe_interval):
+                    last_probe = probe_t0 = time.time()
+                    probe = start_probe()
             if job and time.time() - job[2] > job[1] + args.grace:
                 log(f"job {job[0]} exceeded {job[1]:.0f}s+{args.grace:.0f}s "
                     "wall — wedged RPC; killing session")
@@ -132,6 +212,8 @@ def main():
                 outcome = "deadline"
                 break
             time.sleep(15)
+        if probe is not None and probe.poll() is None:
+            kill_tree(probe, grace=15)
         logfh.close()
         log(f"attempt {attempt} outcome: {outcome}")
         if outcome == "complete":
@@ -140,6 +222,7 @@ def main():
         if outcome == "deadline":
             break
         sleep = (args.wedge_sleep if outcome == "wedged"
+                 else 5 if outcome == "stale-pending"
                  else args.retry_sleep)
         log(f"sleeping {sleep:.0f}s before retry")
         time.sleep(sleep)
