@@ -161,10 +161,12 @@ def main():
                     job = (m.group(1), float(m.group(2)), time.time())
                 if re.search(r"DONE \S+", ln):
                     job = None
+                if "INIT_FAILED" in ln:
+                    outcome = "init-failed"
                 if "ALL DONE" in ln or "PASS COMPLETE" in ln:
                     outcome = "complete"
             if rc is not None:
-                if outcome != "complete":
+                if outcome is None:
                     outcome = f"exited rc={rc}"
                 break
             if not inited and time.time() - t_start > args.init_timeout:
@@ -223,6 +225,10 @@ def main():
             break
         sleep = (args.wedge_sleep if outcome == "wedged"
                  else 5 if outcome == "stale-pending"
+                 # clean fast-fail init: the plugin already waited out its
+                 # internal retry window; relaunch promptly to keep a
+                 # pending request in the tunnel's queue at all times
+                 else 30 if outcome == "init-failed"
                  else args.retry_sleep)
         log(f"sleeping {sleep:.0f}s before retry")
         time.sleep(sleep)

@@ -216,8 +216,14 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    jnp.zeros(8).block_until_ready()
+    try:
+        dev = jax.devices()[0]
+        jnp.zeros(8).block_until_ready()
+    except RuntimeError as e:
+        # the plugin can fail fast (UNAVAILABLE after its internal retry
+        # window) instead of blocking — surface it cleanly for the loop
+        mark(f"INIT_FAILED {str(e)[:200]}")
+        return 4
     mark(f"INIT_OK platform={dev.platform} kind="
          f"{getattr(dev, 'device_kind', '?')}")
     if dev.platform != "tpu" and not args.allow_cpu:
