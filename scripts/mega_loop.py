@@ -220,6 +220,15 @@ def main():
         log(f"attempt {attempt} outcome: {outcome}")
         if outcome == "complete":
             log("pass complete")
+            try:
+                subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "scripts", "window_digest.py"),
+                     "--round", os.environ.get("ROUND", "r04")],
+                    timeout=120, cwd=REPO,
+                )
+            except Exception as e:  # noqa: BLE001 — digest is best-effort
+                log(f"digest generation failed: {e}")
             return 0
         if outcome == "deadline":
             break
