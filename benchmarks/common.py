@@ -340,6 +340,44 @@ def _supervised() -> bool:
     return bool(os.environ.get("QUIVER_BENCH_SUPERVISED"))
 
 
+def _select_prng(platform: str) -> str | None:
+    """Pick the PRNG implementation for benchmark runs.
+
+    Threefry (jax's default) burns vector cycles generating bits; XLA's
+    ``rbg`` RngBitGenerator is the fast TPU path and the sampler draws
+    ~1M randints per products batch, so on TPU benchmarks default to rbg
+    (override with QUIVER_PRNG=threefry|rbg|default). Correctness is
+    PRNG-agnostic — the validity oracle and dedup semantics never depend
+    on WHICH uniform bits arrive (tests/test_sampler_api.py) — only
+    draw-for-draw reproducibility across impls changes, which no recorded
+    artifact relies on. Returns the impl applied, or None for default.
+    """
+    import os
+
+    import jax
+
+    impl = os.environ.get("QUIVER_PRNG")
+    if impl in (None, "") and platform == "tpu":
+        impl = "rbg"
+    if impl in (None, "", "default", "threefry"):
+        return None
+    try:
+        jax.config.update("jax_default_prng_impl", impl)
+        return impl
+    except Exception as e:  # noqa: BLE001 — a perf knob must not kill a run
+        log(f"prng impl {impl!r} not applied: {e}")
+        return None
+
+
+def _finish_init(dev):
+    """Post-init knobs applied on EVERY successful backend resolution."""
+    impl = _select_prng(dev.platform)
+    if impl:
+        log(f"prng: {impl}")
+        set_record_context(prng=impl)
+    return dev
+
+
 def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 180.0):
     """Touch the JAX backend FIRST and fail fast with a diagnostic.
 
@@ -376,14 +414,14 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
         # CPU backend cannot hang; skip the subprocess probe
         dev = jax.devices()[0]
         log(f"backend ok: {dev.platform} (forced cpu)")
-        return dev
+        return _finish_init(dev)
 
     if _supervised():
         # no probe, no watchdog thread: the supervisor kills us on hang and
         # retries on error. Just touch the backend directly.
         dev = jax.devices()[0]
         log(f"backend ok: {dev.platform} (supervised)")
-        return dev
+        return _finish_init(dev)
 
     last_err = None
     inproc_hung = False
@@ -394,7 +432,7 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
             log(f"backend probe ok: {detail} ({time.time() - t0:.1f}s)")
             dev, err = _init_inprocess(probe_timeout)
             if dev is not None:
-                return dev
+                return _finish_init(dev)
             detail = err
             inproc_hung = "hung" in (err or "")
             if inproc_hung:
@@ -432,7 +470,7 @@ def init_backend(retries: int = 1, delay: float = 15.0, probe_timeout: float = 1
         _reexec_cpu_smoke(str(last_err))  # never returns
     jax.config.update("jax_platforms", "cpu")
     _DEGRADED_REASON = str(last_err)[:300]
-    return jax.devices()[0]
+    return _finish_init(jax.devices()[0])
 
 
 # set when init_backend fell back to CPU; emit() stamps it into the JSON
