@@ -75,6 +75,35 @@ class TestGraphCache:
         assert topo.node_count == 5000
 
 
+class TestPrngSelection:
+    def _restore(self):
+        import jax
+
+        jax.config.update("jax_default_prng_impl", "threefry2x32")
+
+    def test_tpu_defaults_to_rbg(self, monkeypatch):
+        monkeypatch.delenv("QUIVER_PRNG", raising=False)
+        try:
+            assert common._select_prng("tpu") == "rbg"
+        finally:
+            self._restore()
+
+    def test_cpu_defaults_to_none(self, monkeypatch):
+        monkeypatch.delenv("QUIVER_PRNG", raising=False)
+        assert common._select_prng("cpu") is None
+
+    def test_explicit_threefry_means_default(self, monkeypatch):
+        monkeypatch.setenv("QUIVER_PRNG", "threefry")
+        assert common._select_prng("tpu") is None
+
+    def test_override_applies_on_cpu(self, monkeypatch):
+        monkeypatch.setenv("QUIVER_PRNG", "rbg")
+        try:
+            assert common._select_prng("cpu") == "rbg"
+        finally:
+            self._restore()
+
+
 def _job(key, value=1.0, error=None, smoke=False, records=None):
     if records is None:
         records = [] if error else [
