@@ -47,7 +47,7 @@ def main():
     p.add_argument("--classes", type=int, default=47)
     p.add_argument("--cache-ratio", type=float, default=0.2)
     p.add_argument("--model", default="sage",
-                   choices=["sage", "gat", "gcn"])
+                   choices=["sage", "gat", "gcn", "gin"])
     p.add_argument(
         "--mode",
         default="HBM",
@@ -141,6 +141,11 @@ def _body(args):
         from quiver_tpu.models.gcn import GCN
 
         model = GCN(hidden=args.hidden, num_classes=args.classes,
+                    num_layers=len(args.fanout), dtype=dtype)
+    elif args.model == "gin":
+        from quiver_tpu.models.gin import GIN
+
+        model = GIN(hidden=args.hidden, num_classes=args.classes,
                     num_layers=len(args.fanout), dtype=dtype)
     else:
         model = GraphSAGE(

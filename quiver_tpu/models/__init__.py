@@ -7,10 +7,12 @@ evaluation path, examples/pyg/reddit_quiver.py:68-92)."""
 
 from .gat import GAT
 from .gcn import GCN, GCNConv
+from .gin import GIN, GINConv
 from .inference import (
     full_neighbor_mean,
     gat_layerwise_inference,
     gcn_layerwise_inference,
+    gin_layerwise_inference,
     rgcn_layerwise_inference,
     sage_layerwise_inference,
 )
@@ -21,12 +23,15 @@ __all__ = [
     "GAT",
     "GCN",
     "GCNConv",
+    "GIN",
+    "GINConv",
     "GraphSAGE",
     "RGCN",
     "SAGEConv",
     "full_neighbor_mean",
     "gat_layerwise_inference",
     "gcn_layerwise_inference",
+    "gin_layerwise_inference",
     "rgcn_layerwise_inference",
     "sage_layerwise_inference",
 ]

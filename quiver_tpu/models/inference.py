@@ -36,6 +36,7 @@ __all__ = [
     "sage_layerwise_inference",
     "gat_layerwise_inference",
     "gcn_layerwise_inference",
+    "gin_layerwise_inference",
     "rgcn_layerwise_inference",
 ]
 
@@ -238,6 +239,36 @@ def gcn_layerwise_inference(model, params, topo, x_all,
             {"params": params[f"conv{i}"]}, agg, method=GCNConv.combine
         )
         if i != model.num_layers - 1:
+            x = jax.nn.relu(x)
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def gin_layerwise_inference(model, params, topo, x_all,
+                            chunk: int = 1 << 21,
+                            mode: str | SampleMode = SampleMode.HBM):
+    """Layer-wise full-neighbor GIN inference: SUM aggregation over the
+    full graph, ``MLP((1+eps)·x + A·x)`` per layer — exactly what GINConv
+    computes on a block covering every node (sum = mean · degree, reusing
+    the chunked mean machinery)."""
+    from .gin import GINConv
+
+    x = jnp.asarray(x_all)
+    indptr, indices, host = _place(topo, mode)
+    deg = jnp.diff(indptr).astype(x.dtype)
+    for i in range(model.num_layers):
+        last = i == model.num_layers - 1
+        conv = GINConv(
+            features=model.num_classes if last else model.hidden,
+            mlp_hidden=model.hidden,
+            train_eps=model.train_eps,
+        )
+        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host)
+        agg = agg * deg[:, None]
+        p_i = {"params": params[f"conv{i}"]}
+        eps = p_i["params"]["eps"] if model.train_eps else conv.eps_init
+        z = agg + (1.0 + eps) * x
+        x = conv.apply(p_i, z, method=GINConv.combine)
+        if not last:
             x = jax.nn.relu(x)
     return jax.nn.log_softmax(x, axis=-1)
 

@@ -420,6 +420,38 @@ def test_epoch_scan_gcn():
     assert last < first, (first, last)
 
 
+def test_epoch_scan_gin():
+    """The whole-epoch program must also serve the GIN family (sum
+    aggregation + MLP inside the scan body)."""
+    from quiver_tpu.models.gin import GIN
+
+    ei, feat, labels = _labeled_graph()
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=4, feature=2)
+    sampler = GraphSageSampler(topo, [5, 5], seed=3)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat[:n])
+    model = GIN(hidden=16, num_classes=4, num_layers=2)
+    trainer = DistributedTrainer(
+        mesh, sampler, feature, model, optax.adam(5e-3), local_batch=32
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    idx = np.random.default_rng(1).integers(0, n, 4 * trainer.global_batch)
+    first = last = None
+    for e in range(3):
+        seed_mat = trainer.pack_epoch(idx, seed=e)
+        params, opt, losses = trainer.epoch_scan(
+            params, opt, seed_mat, labels_dev, jax.random.PRNGKey(e)
+        )
+        losses = np.asarray(losses)
+        assert np.all(np.isfinite(losses))
+        if first is None:
+            first = losses[0]
+        last = losses[-1]
+    assert last < first, (first, last)
+
+
 def test_epoch_scan_gat():
     """The whole-epoch program must also serve the GAT family (attention
     aggregation inside the scan body)."""
