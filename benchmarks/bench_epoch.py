@@ -131,27 +131,11 @@ def _body(args):
     )
 
     dtype = "bfloat16" if args.bf16 else None
-    if args.model == "gat":
-        from quiver_tpu.models.gat import GAT
+    from benchmarks.common import model_from_name
 
-        model = GAT(hidden=args.hidden, num_classes=args.classes,
-                    num_layers=len(args.fanout), heads=args.heads,
-                    dtype=dtype)
-    elif args.model == "gcn":
-        from quiver_tpu.models.gcn import GCN
-
-        model = GCN(hidden=args.hidden, num_classes=args.classes,
-                    num_layers=len(args.fanout), dtype=dtype)
-    elif args.model == "gin":
-        from quiver_tpu.models.gin import GIN
-
-        model = GIN(hidden=args.hidden, num_classes=args.classes,
-                    num_layers=len(args.fanout), dtype=dtype)
-    else:
-        model = GraphSAGE(
-            hidden=args.hidden, num_classes=args.classes,
-            num_layers=len(args.fanout), dtype=dtype,
-        )
+    model, _, _ = model_from_name(args.model, args.hidden, args.classes,
+                                  len(args.fanout), heads=args.heads,
+                                  dtype=dtype)
     tx = optax.adam(1e-3)
     rng = np.random.default_rng(args.seed + 1)
 

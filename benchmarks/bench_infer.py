@@ -2,8 +2,9 @@
 
 Measures the whole-graph evaluation path (models/inference.py — the
 reference's ``model.inference``, examples/pyg/reddit_quiver.py:68-92): a
-complete 2-layer GraphSAGE pass over EVERY node using ALL edges, as chunked
-segment aggregation. Metric: nodes/s of finished final-layer embeddings
+complete multi-layer pass over EVERY node using ALL edges, as chunked
+segment aggregation — any of the homogeneous families (--model
+sage|gcn|gin|gat). Metric: nodes/s of finished final-layer embeddings
 (= N / wall for the full multi-layer pass); extras carry the per-pass edge
 throughput. No reference number exists (it never benchmarked inference);
 this row tracks the framework's own capability.
@@ -20,8 +21,14 @@ def main():
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=47)
     p.add_argument("--layers", type=int, default=2)
-    p.add_argument("--chunk", type=int, default=1 << 21)
+    p.add_argument("--chunk", type=int, default=None,
+                   help="edges per aggregation program (default: each "
+                   "family's tuned default — GAT halves it for its "
+                   "per-chunk (chunk, heads, F) buffers)")
     p.add_argument("--mode", default="HBM", choices=["HBM", "HOST"])
+    p.add_argument("--model", default="sage",
+                   choices=["sage", "gcn", "gin", "gat"])
+    p.add_argument("--heads", type=int, default=4, help="GAT heads")
     p.set_defaults(iters=3, warmup=1)
     args = p.parse_args()
     run_guarded(lambda: _body(args), args)
@@ -32,8 +39,7 @@ def _body(args):
 
     import jax
 
-    from quiver_tpu.models.inference import sage_layerwise_inference
-    from quiver_tpu.models.sage import GraphSAGE
+    from benchmarks.common import model_from_name
     from quiver_tpu.parallel.train import init_model
 
     topo = build_graph(args)
@@ -41,8 +47,8 @@ def _body(args):
     x_all = np.random.default_rng(args.seed).normal(
         size=(n, args.feature_dim)
     ).astype(np.float32)
-    model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
-                      num_layers=args.layers)
+    model, infer, edge_sweeps = model_from_name(
+        args.model, args.hidden, args.classes, args.layers, heads=args.heads)
 
     # params via a tiny sampled batch (inference reuses conv{i} weights)
     from quiver_tpu import GraphSageSampler
@@ -60,15 +66,15 @@ def _body(args):
 
     t0 = time.time()
     for _ in range(max(args.warmup, 1)):  # >= 1: the first pass compiles
-        logp = sage_layerwise_inference(model, params, topo, x_all,
-                                        chunk=args.chunk, mode=args.mode)
+        logp = infer(model, params, topo, x_all, mode=args.mode,
+                     **({"chunk": args.chunk} if args.chunk else {}))
     jax.block_until_ready(logp)
     log(f"warmup+compile: {time.time() - t0:.1f}s")
 
     t0 = time.time()
     for _ in range(args.iters):
-        logp = sage_layerwise_inference(model, params, topo, x_all,
-                                        chunk=args.chunk, mode=args.mode)
+        logp = infer(model, params, topo, x_all, mode=args.mode,
+                     **({"chunk": args.chunk} if args.chunk else {}))
     jax.block_until_ready(logp)
     dt = time.time() - t0
 
@@ -79,9 +85,11 @@ def _body(args):
         "nodes/s",
         None,
         mode=args.mode,
+        model=args.model,
         layers=args.layers,
         pass_seconds=round(per_pass, 3),
-        edges_per_sec=round(args.layers * topo.edge_count / per_pass, 1),
+        edges_per_sec=round(
+            edge_sweeps * args.layers * topo.edge_count / per_pass, 1),
     )
 
 

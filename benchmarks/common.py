@@ -640,6 +640,42 @@ def build_graph(args):
     return topo
 
 
+def model_from_name(name: str, hidden: int, classes: int,
+                    num_layers: int, heads: int = 4, dtype=None):
+    """Shared --model dispatch for the homogeneous families.
+
+    Returns (model, layerwise_inference_fn, edge_sweeps_per_layer) — the
+    sweep count feeds honest edge-throughput extras (GAT walks the edge
+    array twice per layer: segment-max then the fused num/denom pass).
+    """
+    from quiver_tpu.models import (
+        gat_layerwise_inference,
+        gcn_layerwise_inference,
+        gin_layerwise_inference,
+        sage_layerwise_inference,
+    )
+
+    kw = dict(hidden=hidden, num_classes=classes, num_layers=num_layers,
+              dtype=dtype)
+    if name == "gat":
+        from quiver_tpu.models.gat import GAT
+
+        return GAT(**kw, heads=heads), gat_layerwise_inference, 2
+    if name == "gcn":
+        from quiver_tpu.models.gcn import GCN
+
+        return GCN(**kw), gcn_layerwise_inference, 1
+    if name == "gin":
+        from quiver_tpu.models.gin import GIN
+
+        return GIN(**kw), gin_layerwise_inference, 1
+    if name == "sage":
+        from quiver_tpu.models.sage import GraphSAGE
+
+        return GraphSAGE(**kw), sage_layerwise_inference, 1
+    raise ValueError(f"unknown model family {name!r}")
+
+
 def trimmed_mean(times) -> float:
     """10%-trimmed mean of iteration times (the reference drops the first
     epoch and averages the rest; per-iteration trimming is the same idea at
