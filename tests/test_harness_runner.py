@@ -166,6 +166,61 @@ def _load_mega_session():
     return mod
 
 
+class TestBenchInitWatchdog:
+    """bench.py's measured-child supervision: a child that never reaches
+    backend init is killed fast (grant starvation), while initialized
+    children keep the full budget."""
+
+    @pytest.fixture()
+    def bench_mod(self, monkeypatch):
+        import importlib.util
+        import sys
+
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(REPO, "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(sys, "argv", ["bench.py"])
+        return mod
+
+    def test_starved_child_killed_at_init_timeout(self, bench_mod, monkeypatch):
+        monkeypatch.setattr(
+            bench_mod, "CHILD", ["-c", "import time; time.sleep(120)"])
+        t0 = __import__("time").time()
+        rec, err, hung = bench_mod._attempt(
+            [], {}, timeout_s=60, label="t", init_timeout=3)
+        assert rec is None
+        assert "starved" in err
+        assert not hung  # starvation is retryable, not a mid-run hang
+        assert __import__("time").time() - t0 < 30
+
+    def test_initialized_child_record_harvested(self, bench_mod, monkeypatch):
+        src = (
+            "import sys, json;"
+            "print('backend ok: cpu', file=sys.stderr);"
+            "print(json.dumps({'metric': 'sampled-edges/sec/chip',"
+            " 'value': 1.0, 'unit': 'SEPS', 'vs_baseline': None}))"
+        )
+        monkeypatch.setattr(bench_mod, "CHILD", ["-c", src])
+        rec, err, hung = bench_mod._attempt(
+            [], {}, timeout_s=60, label="t", init_timeout=30)
+        assert err is None and not hung
+        assert rec["metric"] == "sampled-edges/sec/chip"
+
+    def test_post_init_hang_is_a_timeout(self, bench_mod, monkeypatch):
+        src = (
+            "import sys, time;"
+            "print('backend ok: cpu', file=sys.stderr, flush=True);"
+            "time.sleep(120)"
+        )
+        monkeypatch.setattr(bench_mod, "CHILD", ["-c", src])
+        rec, err, hung = bench_mod._attempt(
+            [], {}, timeout_s=12, label="t", init_timeout=6)
+        assert rec is None
+        assert err.startswith("timeout")
+        assert hung
+
+
 class TestJobTableDrift:
     def test_table_covers_scoreboard_jobs(self):
         ms = _load_mega_session()
