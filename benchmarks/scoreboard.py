@@ -280,8 +280,9 @@ def write_outputs(results, out, smoke, merge=False):
             extras = {k: v for k, v in rec.items()
                       if k in ("kernel", "mode", "policy", "caps", "sampler",
                                "layer", "stage", "dispatch", "stream_batches",
-                               "dedup", "roofline_frac", "topo_mode",
-                               "cache_ratio", "elected")}
+                               "dedup", "roofline_frac", "ceiling_gbps",
+                               "topo_mode", "cache_ratio", "elected",
+                               "model", "prng")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(
