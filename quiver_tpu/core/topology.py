@@ -226,6 +226,35 @@ class CSRTopo:
     def __repr__(self):
         return f"CSRTopo(nodes={self.node_count}, edges={self.edge_count})"
 
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist the topology (CSR + eid + weights + feature_order) as
+        one ``.npz``. The reference's users ``torch.save`` their CSR
+        preprocessing artifacts (benchmarks/ogbn-papers100M/preprocess.py);
+        this is the same round-trip without a torch dependency."""
+        arrays = {"indptr": self._indptr, "indices": self._indices}
+        for name in ("eid", "edge_weight", "feature_order"):
+            v = getattr(self, f"_{name}")
+            if v is not None:
+                arrays[name] = v
+        with open(path, "wb") as fh:  # exact filename, no np .npz suffixing
+            np.savez(fh, **arrays)
+
+    @classmethod
+    def load(cls, path: str) -> "CSRTopo":
+        """Rebuild a :meth:`save`'d topology. Weights re-derive their
+        per-row prefix sums; they are stored CSR-ordered, so coo_order is
+        False on the way back in."""
+        with np.load(path) as z:
+            topo = cls(indptr=z["indptr"], indices=z["indices"],
+                       eid=z["eid"] if "eid" in z.files else None)
+            if "edge_weight" in z.files:
+                topo.set_edge_weight(z["edge_weight"], coo_order=False)
+            if "feature_order" in z.files:
+                topo.feature_order = z["feature_order"]
+        return topo
+
     # -- device placement ---------------------------------------------------
 
     def to_device(self, mode: SampleMode | str = SampleMode.HBM,

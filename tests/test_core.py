@@ -95,3 +95,37 @@ def test_reorder_invariant():
     hot_nodes = np.where(new_order < hot)[0]
     cold_nodes = np.where(new_order >= hot)[0]
     assert deg[hot_nodes].min() >= deg[cold_nodes].max() - 0  # sorted split
+
+
+def test_csr_save_load_roundtrip(tmp_path):
+    """save/load preserves CSR arrays, eid, CSR-ordered weights (and their
+    prefix sums), and feature_order."""
+    rng = np.random.default_rng(5)
+    ei = rng.integers(0, 50, (2, 400))
+    topo = CSRTopo(edge_index=ei)
+    topo.set_edge_weight(rng.random(400).astype(np.float32), coo_order=True)
+    topo.feature_order = np.asarray(rng.permutation(topo.node_count))
+
+    p = str(tmp_path / "topo.npz")
+    topo.save(p)
+    back = CSRTopo.load(p)
+
+    np.testing.assert_array_equal(topo.indptr, back.indptr)
+    np.testing.assert_array_equal(topo.indices, back.indices)
+    np.testing.assert_array_equal(topo.eid, back.eid)
+    np.testing.assert_array_equal(topo.feature_order, back.feature_order)
+    np.testing.assert_allclose(topo.edge_weight, back.edge_weight)
+    np.testing.assert_allclose(topo.cum_weights, back.cum_weights)
+
+
+def test_csr_save_load_minimal(tmp_path):
+    """A weightless, orderless topology round-trips too (optional arrays
+    absent from the npz, not stored as empties)."""
+    ei = np.array([[0, 1, 2], [1, 2, 0]])
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "t.npz")
+    topo.save(p)
+    back = CSRTopo.load(p)
+    np.testing.assert_array_equal(topo.indptr, back.indptr)
+    np.testing.assert_array_equal(topo.indices, back.indices)
+    assert back.edge_weight is None and back.feature_order is None
