@@ -186,11 +186,22 @@ def _attempt(extra_args, env_overrides, timeout_s, label, init_timeout=None):
                 time.sleep(5)
         finally:
             if proc.poll() is None:
-                proc.terminate()
+                # kill discipline (mirrors mega_loop.kill_tree): a child
+                # past backend init holds the grant, and a SIGKILLed holder
+                # wedges the chip ~10 min — INT first with a grace period,
+                # then escalate. A pre-init child holds nothing; INT-first
+                # costs only the grace.
+                import signal
+
                 try:
-                    proc.wait(30)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
+                    proc.send_signal(signal.SIGINT)
+                    proc.wait(30 if inited else 10)
+                except (OSError, subprocess.TimeoutExpired):
+                    proc.terminate()
+                    try:
+                        proc.wait(30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
             proc.wait()  # always reap
         with open(out_path, "rb") as fh:
             out = fh.read().decode("utf-8", "replace")

@@ -558,6 +558,28 @@ def apply_smoke(args) -> None:
         log(f"smoke mode: nodes={args.nodes} iters={args.iters}")
 
 
+def _graphgen_tag() -> str:
+    """Short content hash of the generator source.
+
+    The cache key must change whenever generate_pareto_graph's output
+    could: a (nodes, degree, seed)-only key silently serves stale graphs
+    across generator edits — the same staleness class the explicit eid
+    guard below already caught once.
+    """
+    import hashlib
+    import os
+
+    src = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "quiver_tpu", "utils", "graphgen.py",
+    )
+    try:
+        with open(src, "rb") as fh:
+            return hashlib.md5(fh.read()).hexdigest()[:8]
+    except OSError:
+        return "nosrc"
+
+
 def _graph_cache_path(nodes: int, avg_degree: float, seed: int) -> str:
     import os
 
@@ -565,7 +587,9 @@ def _graph_cache_path(nodes: int, avg_degree: float, seed: int) -> str:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         ".graph_cache",
     )
-    return os.path.join(d, f"pareto_n{nodes}_d{avg_degree:g}_s{seed}.npz")
+    return os.path.join(
+        d, f"pareto_n{nodes}_d{avg_degree:g}_s{seed}_g{_graphgen_tag()}.npz"
+    )
 
 
 def build_graph(args):

@@ -230,6 +230,20 @@ def write_outputs(results, out, smoke, merge=False):
                 prior = {j["key"]: j for j in json.load(fh).get("jobs", [])}
         except (ValueError, KeyError):
             prior = {}
+        def _quality(job):
+            """Evidence rank of a job row: 2 full-scale TPU, 1 smoke/degraded
+            TPU, 0 CPU/none. Higher-ranked prior rows must never be silently
+            replaced by lower-ranked re-runs (a smoke rehearsal pointed at
+            the same out dir would otherwise erase chip evidence)."""
+            best = 0
+            for rec in job.get("records") or []:
+                if rec.get("platform") == "tpu" and not rec.get("stale"):
+                    if rec.get("smoke") or rec.get("degraded"):
+                        best = max(best, 1)
+                    else:
+                        best = max(best, 2)
+            return best
+
         for job in results:
             old = prior.get(job["key"])
             if old and old.get("records") and not job.get("records"):
@@ -237,6 +251,17 @@ def write_outputs(results, out, smoke, merge=False):
                 # keep the good row, note the newer failure on it
                 old = dict(old)
                 old["retry_error"] = job.get("error")
+                prior[job["key"]] = old
+                continue
+            if old and _quality(old) > _quality(job):
+                # weaker evidence (smoke/degraded/CPU) must not displace a
+                # full-scale TPU row; keep the strong row and stash the
+                # newer weak one so nothing is lost either way
+                old = dict(old)
+                old["superseded_attempt"] = {
+                    k: job.get(k)
+                    for k in ("records", "error", "seconds", "smoke")
+                }
                 prior[job["key"]] = old
                 continue
             prior[job["key"]] = job
