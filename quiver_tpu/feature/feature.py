@@ -344,6 +344,10 @@ class Feature(KernelChoice):
     """Tiered node-feature table with jit-compatible lookup.
 
     Args mirror the reference's constructor (feature.py:29-44):
+      rank, device_list: accepted-and-INERT parity slots. The reference
+        pins one CUDA device per process rank; under single-controller
+        SPMD the mesh owns placement, so these only survive as attributes
+        for call-site compatibility — nothing reads them.
       device_cache_size: hot-tier byte budget ("0.9M", "3GB", int bytes).
       cache_policy: "device_replicate" | "p2p_clique_replicate"/"mesh_shard".
       csr_topo: enables degree-based hot ordering; sets csr_topo.feature_order.

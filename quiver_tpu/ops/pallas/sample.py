@@ -16,10 +16,18 @@ so the design flips to **window sampling**:
 
 Distribution: rows with deg <= window are *identical in distribution* to the
 XLA sampler (window = whole row, same strata). Rows with deg > window sample
-from a uniformly-placed contiguous window — marginals stay near-uniform with
-boundary attenuation only for the first/last (window-1) neighbors of such
-rows; with the default window 2048 this affects the <0.1% power-law tail.
-The exact XLA path remains the correctness reference.
+from a uniformly-placed contiguous window: slot p's marginal is
+``n(p)/T * k/window`` with ``T = deg-window+1`` placements and
+``n(p) = min(p, T-1) - max(p-window+1, 0) + 1`` — interior slots boosted by
+``deg/T`` over the exact ``k/deg``, the first/last (window-1) slots
+attenuated linearly toward the row ends. With the default window 2048 this
+affects the <0.1% power-law tail.
+
+Policy (decided r5, pinned by tests/test_pallas_hub_distribution.py): the
+hub-row attenuation is ACCEPTED rather than patched with multi-window
+draws — ``kernel='pallas'`` is an explicit opt-in, and the exact XLA path
+remains the default and the correctness reference (the reference's
+reservoir kernel, cuda_random.cu.hpp:41-57, is exact at any degree).
 """
 
 from __future__ import annotations

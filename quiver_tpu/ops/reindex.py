@@ -21,7 +21,52 @@ __all__ = [
     "reindex_layer",
     "inverse_permutation",
     "complete_permutation",
+    "resolve_dedup",
 ]
+
+DEDUP_STRATEGIES = ("sort", "map", "scan")
+
+
+def resolve_dedup(dedup: str) -> str:
+    """Resolve a dedup strategy name, mapping ``"auto"`` to the platform
+    default.
+
+    The three strategies are bit-identical (tests/test_reindex.py); only
+    their cost model differs per backend:
+
+    * **cpu** -> ``"map"`` — measured: the dense scatter-min map is 4-5x
+      the sort path at both smoke and full products scale
+      (docs/TPU_MEASUREMENTS_R3.md CPU-floor extras).
+    * **tpu** -> ``"scan"`` — the zero-scatter strategy, chosen because
+      XLA serializes general scatters on TPU while its sort runs at
+      ~1.8 ms/M elements (r3 link characterization); provisional until
+      the ``sampler-hbm --dedup both`` self-selection lands on hardware.
+
+    ``QUIVER_DEDUP=sort|map|scan`` overrides (chip-window forcing).
+    Unknown names raise — a typo must not silently fall back to a
+    strategy (the callers' dispatch treats anything non-map/scan as sort).
+    """
+    if dedup in DEDUP_STRATEGIES:
+        return dedup
+    if dedup != "auto":
+        raise ValueError(
+            f"dedup must be 'auto', 'sort', 'map', or 'scan', got {dedup!r}"
+        )
+    import os
+
+    env = os.environ.get("QUIVER_DEDUP", "").strip().lower()
+    if env:
+        if env not in DEDUP_STRATEGIES:
+            # the env var exists to FORCE a strategy during chip windows;
+            # a typo silently measuring the platform default would be
+            # recorded as the forced strategy — fail instead
+            raise ValueError(
+                f"QUIVER_DEDUP={env!r} is not one of {DEDUP_STRATEGIES}"
+            )
+        return env
+    import jax
+
+    return "scan" if jax.devices()[0].platform == "tpu" else "map"
 
 
 def inverse_permutation(p):

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from ..core.config import SampleMode
 from ..core.hetero import HeteroCSRTopo
-from ..ops.reindex import masked_unique
+from ..ops.reindex import masked_unique, resolve_dedup
 from ..ops.sample import sample_layer
 from .sampler import Adj, _round_up
 
@@ -246,8 +246,9 @@ class HeteroGraphSampler:
       dedup: per-type frontier first-occurrence strategy — "sort" (stable
         sort + run scan), "map" (sort-free scatter-min into a dense
         per-type position map), or "scan" (zero-scatter sorts + cummax +
-        gathers). Identical results; pick by measurement. Mirrors the
-        homogeneous GraphSageSampler option.
+        gathers). Identical results. Default "auto" picks per platform
+        (ops.reindex.resolve_dedup). Mirrors the homogeneous
+        GraphSageSampler option.
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
@@ -255,14 +256,10 @@ class HeteroGraphSampler:
                  seed_capacity: int | None = None,
                  frontier_caps: str | None = None, seed: int = 0,
                  auto_margin: float = 1.25, weighted=False,
-                 with_eid: bool = False, dedup: str = "sort"):
+                 with_eid: bool = False, dedup: str = "auto"):
         if input_type not in topo.num_nodes:
             raise ValueError(f"unknown input_type {input_type!r}")
-        self.dedup = str(dedup)
-        if self.dedup not in ("sort", "map", "scan"):
-            raise ValueError(
-                f"dedup must be 'sort', 'map', or 'scan', got {dedup!r}"
-            )
+        self.dedup = resolve_dedup(str(dedup))  # validates; "auto" -> platform
         self.topo = topo
         self.input_type = input_type
         self.sizes = _normalize_sizes(sizes, topo)

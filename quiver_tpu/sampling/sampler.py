@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..core.config import SampleMode
 from ..core.topology import CSRTopo, DeviceTopology
-from ..ops.reindex import reindex_layer
+from ..ops.reindex import reindex_layer, resolve_dedup
 from ..ops.sample import sample_layer
 from ..utils.trace import trace_scope
 
@@ -101,6 +101,7 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
     """
     if with_eid and kernel == "pallas":
         raise ValueError("kernel='pallas' does not support with_eid")
+    dedup = resolve_dedup(dedup)  # validates; maps "auto" per platform
     adjs = []
     edge_counts = []
     frontier_counts = []
@@ -198,10 +199,14 @@ class GraphSageSampler:
         position map, the reference hash-table analogue,
         reindex.cu.hpp:120-139), or "scan" (zero-scatter: sorts +
         cumulative max + gathers only — for backends where XLA scatter
-        serializes). Identical results; pick by measurement.
+        serializes). Identical results. Default "auto" picks per platform
+        (ops.reindex.resolve_dedup: cpu->map measured, tpu->scan;
+        QUIVER_DEDUP overrides).
       device_topo: advanced — reuse an existing DeviceTopology (built with
         compatible to_device flags) instead of uploading a fresh copy;
         lets many sampler configurations share one device-resident graph.
+      device: accepted-and-INERT parity slot (the reference pins a CUDA
+        ordinal, sage_sampler.py:26; under SPMD the mesh owns placement).
     """
 
     def __init__(
@@ -217,7 +222,7 @@ class GraphSageSampler:
         auto_margin: float = 1.25,
         kernel: str = "xla",
         with_eid: bool = False,
-        dedup: str = "sort",
+        dedup: str = "auto",
         device_topo=None,
     ):
         self.csr_topo = csr_topo
@@ -231,11 +236,7 @@ class GraphSageSampler:
         self.kernel = str(kernel)
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
-        self.dedup = str(dedup)
-        if self.dedup not in ("sort", "map", "scan"):
-            raise ValueError(
-                f"dedup must be 'sort', 'map', or 'scan', got {dedup!r}"
-            )
+        self.dedup = resolve_dedup(str(dedup))  # validates; "auto" -> platform
         if self.kernel == "pallas":
             if weighted:
                 raise ValueError("kernel='pallas' supports unweighted sampling only")

@@ -1,0 +1,121 @@
+"""Distributional test for the windowed Pallas sampler on hub rows.
+
+The windowed kernel (ops/pallas/sample.py) is exact for rows with
+deg <= window, but hub rows (deg > window) sample from a uniformly-placed
+contiguous window. VERDICT r4 flagged that this branch had never been
+exercised distributionally — and the power-law tail is exactly where cache
+policy concentrates reads. This test pins the hub branch to its analytic
+model and quantifies the deviation from the exact XLA sampler.
+
+Analytic model (deg > window): with T = deg - window + 1 uniform window
+placements and an exactly-uniform k/window in-window marginal (the
+stratify+rotate construction, tested in test_sampler_distribution), slot
+p's inclusion probability is
+
+    P(p) = n(p)/T * k/window,   n(p) = min(p, T-1) - max(p-window+1, 0) + 1
+
+i.e. interior slots (window-1 <= p <= T-1) are boosted by deg/T over the
+exact sampler's k/deg, and the first/last window-1 slots attenuate linearly
+toward n(0)/T * k/window at the row ends.
+
+Policy (documented here and in ops/pallas/sample.py): the attenuation is
+ACCEPTED. kernel='pallas' is an explicit opt-in whose hub-row marginals are
+near-uniform only when window << deg or deg >> window is rare (the default
+window 2048 covers >99.9% of power-law rows exactly); the XLA path stays
+the exactness reference and the default. Reference exactness standard:
+torch-quiver cuda_random.cu.hpp:41-57 (reservoir, exact at any degree).
+"""
+
+import numpy as np
+import pytest
+
+from quiver_tpu import CSRTopo
+
+DEG = 256  # hub degree
+WINDOW = 64
+K = 8
+TRIALS = 8192  # rows per batch x batches
+ROWS = 1024
+T = DEG - WINDOW + 1  # 193 window placements
+
+
+@pytest.fixture(scope="module")
+def hub_topo():
+    # node 0 is the hub: neighbors 1..DEG in CSR order, so sampled neighbor
+    # id - 1 IS the CSR slot position (the quantity the model is over)
+    indptr = np.zeros(DEG + 2, dtype=np.int64)
+    indptr[1:] = DEG
+    indices = np.arange(1, DEG + 1, dtype=np.int64)
+    return CSRTopo(indptr=indptr, indices=indices)
+
+
+def _analytic_marginal():
+    p = np.arange(DEG)
+    n = np.minimum(p, T - 1) - np.maximum(p - WINDOW + 1, 0) + 1
+    return n / T * (K / WINDOW)
+
+
+@pytest.fixture(scope="module")
+def windowed_counts(hub_topo):
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops.pallas.sample import sample_layer_windowed
+
+    seeds = jnp.zeros(ROWS, dtype=jnp.int32)
+    counts = np.zeros(DEG, dtype=np.int64)
+    key = jax.random.PRNGKey(7)
+    for _ in range(TRIALS // ROWS):
+        key, sub = jax.random.split(key)
+        nbr, cnt = sample_layer_windowed(
+            hub_topo, seeds, ROWS, K, sub, window=WINDOW, interpret=True
+        )
+        nbr = np.asarray(nbr)
+        assert np.all(np.asarray(cnt) == K)
+        # every draw valid and per-row distinct (distinct CSR slots)
+        assert nbr.min() >= 1 and nbr.max() <= DEG
+        assert all(len(set(r.tolist())) == K for r in nbr)
+        np.add.at(counts, nbr.ravel() - 1, 1)
+    return counts
+
+
+def test_hub_marginals_match_analytic_model(windowed_counts):
+    counts = windowed_counts
+    exp = _analytic_marginal() * TRIALS
+    assert counts.sum() == TRIALS * K
+    # per-slot: 5-sigma binomial band (distinct-slot draws within a row are
+    # negatively correlated, so the independent-binomial sigma is an upper
+    # bound on the true one)
+    sigma = np.sqrt(TRIALS * _analytic_marginal() * (1 - _analytic_marginal()))
+    dev = np.abs(counts - exp)
+    worst = int(np.argmax(dev - 5 * sigma - 3))
+    assert np.all(dev <= 5 * sigma + 3), (
+        f"slot {worst}: observed {counts[worst]}, expected {exp[worst]:.1f}"
+    )
+    # aggregate shape: the interior mass must match the model's boosted
+    # level (deg/T over uniform), clearly separated from the flat
+    # k/deg the exact sampler would give (model 0.6736 vs flat 0.5078)
+    interior = slice(WINDOW - 1, T)
+    frac = counts[interior].sum() / (TRIALS * K)
+    model_frac = _analytic_marginal()[interior].sum() / K
+    assert abs(frac - model_frac) < 0.02
+    # boundary attenuation is real: the end slots see ~T/deg of the flat
+    # rate; slot 0's expectation is ~5.3 draws vs 256 flat
+    assert counts[0] < 40 and counts[-1] < 40
+
+
+def test_hub_deviation_from_exact_sampler_is_bounded(windowed_counts):
+    """Total-variation distance to the exact (flat k/deg) marginal equals
+    the analytic TV of the window scheme — the accepted-policy bound."""
+    counts = windowed_counts
+    emp = counts / counts.sum()  # normalized draw distribution over slots
+    flat = np.full(DEG, 1.0 / DEG)
+    model = _analytic_marginal() / K
+    tv_emp = 0.5 * np.abs(emp - flat).sum()
+    tv_model = 0.5 * np.abs(model - flat).sum()
+    # empirical TV within noise of the analytic TV, and both far below 1
+    assert abs(tv_emp - tv_model) < 0.03
+    assert tv_model < 0.25  # deg/window = 4: worst-case-ish config
+    # with the production window (2048) and the same deg/window ratio the
+    # bound is identical — the policy accepts exactly this much skew on
+    # hub rows, nothing more

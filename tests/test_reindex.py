@@ -227,3 +227,38 @@ def test_complete_permutation_rejects_overlong():
 
     with pytest.raises(ValueError, match="longer"):
         complete_permutation(jnp.arange(10, dtype=jnp.int32), 5)
+
+
+def test_resolve_dedup_platform_and_env(monkeypatch):
+    """'auto' -> platform default (cpu->map here; tpu->scan by policy),
+    QUIVER_DEDUP overrides, explicit names pass through untouched."""
+    from quiver_tpu.ops.reindex import resolve_dedup
+
+    monkeypatch.delenv("QUIVER_DEDUP", raising=False)
+    assert resolve_dedup("sort") == "sort"  # explicit passthrough
+    assert resolve_dedup("auto") == "map"  # tests pin JAX_PLATFORMS=cpu
+    monkeypatch.setenv("QUIVER_DEDUP", "scan")
+    assert resolve_dedup("auto") == "scan"
+    import pytest
+
+    monkeypatch.setenv("QUIVER_DEDUP", "bogus")  # a typo'd FORCE must raise
+    with pytest.raises(ValueError, match="QUIVER_DEDUP"):
+        resolve_dedup("auto")
+    with pytest.raises(ValueError, match="dedup"):
+        resolve_dedup("hash")  # unknown explicit name rejected too
+
+
+def test_sampler_dedup_auto_resolves(monkeypatch):
+    from quiver_tpu import CSRTopo, GraphSageSampler
+
+    monkeypatch.delenv("QUIVER_DEDUP", raising=False)
+    rng = np.random.default_rng(0)
+    topo = CSRTopo(edge_index=rng.integers(0, 50, (2, 400)).astype(np.int64))
+    s = GraphSageSampler(topo, [3], seed_capacity=16)
+    assert s.dedup == "map"  # resolved, never the literal "auto"
+    out = s.sample(np.arange(16))
+    assert int(out.n_count) >= 16
+    import pytest
+
+    with pytest.raises(ValueError, match="dedup"):
+        GraphSageSampler(topo, [3], dedup="hash")
