@@ -21,7 +21,7 @@ from typing import Sequence
 import jax.numpy as jnp
 import flax.linen as nn
 
-from .layers import segment_softmax
+from .layers import fanout_softmax, fanout_sum_aggregate, segment_softmax
 
 __all__ = ["GATConv", "GAT"]
 
@@ -79,27 +79,33 @@ class GATConv(nn.Module):
             return out.reshape(num_dst, self.heads * self.features) + self.bias
         return out.mean(axis=1) + self.bias
 
-    def __call__(self, x, edge_index, num_dst: int):
+    def __call__(self, x, edge_index, num_dst: int, fanout: int | None = None):
         src, dst = edge_index[0], edge_index[1]
         valid = (src >= 0) & (dst >= 0)
         src_safe = jnp.clip(src, 0)
-        dst_safe = jnp.where(valid, dst, num_dst)  # overflow segment
+        dense = fanout is not None and src.shape[0] == num_dst * fanout
 
         h_all, alpha_src, alpha_dst = self.project(x)
         alpha_dst = alpha_dst[:num_dst]
 
         logits = alpha_src[src_safe] + alpha_dst[jnp.clip(dst, 0, num_dst - 1)]
         logits = nn.leaky_relu(logits, self.negative_slope)  # (E, H)
-        # segment softmax over each destination's edges, all heads at once
+        # softmax over each destination's edges, all heads at once
         # (computed in f32 via the att-param promotion for stability, then
-        # downcast so the big (E, H, F) message/scatter traffic runs at the
-        # compute dtype rather than silently promoting back to f32)
-        alpha = segment_softmax(logits, dst_safe, valid, num_dst)  # (E, H)
+        # downcast so the big (E, H, F) message traffic runs at the compute
+        # dtype rather than silently promoting back to f32)
+        if dense:
+            alpha = fanout_softmax(logits, valid, num_dst, fanout)  # (E, H)
+        else:
+            dst_safe = jnp.where(valid, dst, num_dst)  # overflow segment
+            alpha = segment_softmax(logits, dst_safe, valid, num_dst)
         alpha = alpha.astype(h_all.dtype)
 
         msgs = h_all[src_safe] * alpha[:, :, None]  # (E, H, F)
         msgs = jnp.where(valid[:, None, None], msgs, 0.0)
         H, F = self.heads, self.features
+        if dense:
+            return self.finish(fanout_sum_aggregate(msgs, valid, num_dst, fanout))
         out = jnp.zeros((num_dst + 1, H, F), msgs.dtype).at[dst_safe].add(msgs)
         return self.finish(out[:num_dst])
 
@@ -136,7 +142,7 @@ class GAT(nn.Module):
                 concat=not last,
                 dtype=self.dtype,
                 name=f"conv{i}",
-            )(x, adj.edge_index, num_dst)
+            )(x, adj.edge_index, num_dst, getattr(adj, "fanout", None))
             if not last:
                 x = nn.elu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from .layers import fanout_sum_aggregate, occurrence_counts
+
 __all__ = ["GCNConv", "GCN"]
 
 
@@ -46,29 +48,37 @@ class GCNConv(nn.Module):
         inference, which computes the normalized aggregate itself."""
         return self.lin(agg) + self.bias
 
-    def __call__(self, x, edge_index, num_dst: int):
+    def __call__(self, x, edge_index, num_dst: int, fanout: int | None = None):
         N = x.shape[0]
         src, dst = edge_index[0], edge_index[1]
         valid = (src >= 0) & (dst >= 0)
-        src_deg = jnp.where(valid, src, N)  # overflow segments keep
-        dst_safe = jnp.where(valid, dst, num_dst)  # padding out of degrees
-
         one = valid.astype(x.dtype)
+        dense = fanout is not None and src.shape[0] == num_dst * fanout
+
         # in-block degrees of the self-loop-augmented graph: every dst gets
         # +1 (its loop), and a src that is also a dst carries that same loop
-        # edge on its src side
-        deg_dst = jax.ops.segment_sum(
-            one, dst_safe, num_segments=num_dst + 1)[:num_dst] + 1.0
-        deg_src = jax.ops.segment_sum(one, src_deg, num_segments=N + 1)[:N]
+        # edge on its src side. src degrees have no regular layout (sources
+        # land anywhere in the frontier), so they go through the
+        # platform-resolved histogram either way.
+        deg_src = occurrence_counts(src, valid, N, dtype=x.dtype)
         deg_src = deg_src.at[:num_dst].add(1.0)
+        if dense:
+            deg_dst = one.reshape(num_dst, fanout).sum(axis=1) + 1.0
+        else:
+            dst_safe = jnp.where(valid, dst, num_dst)
+            deg_dst = jax.ops.segment_sum(
+                one, dst_safe, num_segments=num_dst + 1)[:num_dst] + 1.0
 
         inv_s_src = jax.lax.rsqrt(jnp.maximum(deg_src, 1.0))
         inv_s_dst = jax.lax.rsqrt(deg_dst)  # >= 1 by the self loop
 
         h = x * inv_s_src[:, None]  # pre-scale once per node, not per edge
         msgs = jnp.where(valid[:, None], h[jnp.clip(src, 0)], 0.0)
-        agg = jax.ops.segment_sum(
-            msgs, dst_safe, num_segments=num_dst + 1)[:num_dst]
+        if dense:
+            agg = fanout_sum_aggregate(msgs, valid, num_dst, fanout)
+        else:
+            agg = jax.ops.segment_sum(
+                msgs, dst_safe, num_segments=num_dst + 1)[:num_dst]
         agg = agg + h[:num_dst]  # the self loop, already src-scaled
         agg = agg * inv_s_dst[:, None]
         return self.combine(agg)
@@ -96,7 +106,7 @@ class GCN(nn.Module):
             num_dst = adj.size[1]
             feats = self.num_classes if i == self.num_layers - 1 else self.hidden
             x = GCNConv(feats, dtype=self.dtype, name=f"conv{i}")(
-                x, adj.edge_index, num_dst
+                x, adj.edge_index, num_dst, getattr(adj, "fanout", None)
             )
             if i != self.num_layers - 1:
                 x = nn.relu(x)

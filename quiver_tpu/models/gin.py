@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from .layers import fanout_sum_aggregate
+
 __all__ = ["GINConv", "GIN"]
 
 
@@ -52,14 +54,18 @@ class GINConv(nn.Module):
         which builds the aggregate itself."""
         return self.lin2(nn.relu(self.lin1(z)))
 
-    def __call__(self, x, edge_index, num_dst: int):
+    def __call__(self, x, edge_index, num_dst: int, fanout: int | None = None):
         src, dst = edge_index[0], edge_index[1]
         valid = (src >= 0) & (dst >= 0)
-        dst_safe = jnp.where(valid, dst, num_dst)  # padding -> overflow bucket
 
         msgs = jnp.where(valid[:, None], x[jnp.clip(src, 0)], 0.0)
-        agg = jax.ops.segment_sum(
-            msgs, dst_safe, num_segments=num_dst + 1)[:num_dst]
+        if fanout is not None and msgs.shape[0] == num_dst * fanout:
+            # regular sampler layout: dense reduction, zero scatters
+            agg = fanout_sum_aggregate(msgs, valid, num_dst, fanout)
+        else:
+            dst_safe = jnp.where(valid, dst, num_dst)  # padding -> overflow
+            agg = jax.ops.segment_sum(
+                msgs, dst_safe, num_segments=num_dst + 1)[:num_dst]
         z = agg + (1.0 + self.eps) * x[:num_dst]
         return self.combine(z)
 
@@ -88,7 +94,8 @@ class GIN(nn.Module):
             feats = self.num_classes if i == self.num_layers - 1 else self.hidden
             x = GINConv(feats, mlp_hidden=self.hidden,
                         train_eps=self.train_eps, dtype=self.dtype,
-                        name=f"conv{i}")(x, adj.edge_index, num_dst)
+                        name=f"conv{i}")(x, adj.edge_index, num_dst,
+                                   getattr(adj, "fanout", None))
             if i != self.num_layers - 1:
                 x = nn.relu(x)
                 x = nn.Dropout(self.dropout, deterministic=not train)(x)

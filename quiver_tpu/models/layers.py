@@ -4,9 +4,20 @@ The reference delegates all modeling to PyG (SAGEConv etc. in example
 scripts, examples/pyg/reddit_quiver.py:42-65); quiver-tpu ships its own
 TPU-native GNN layers because PyG/torch are out of the build. Edges arrive
 as padded ``edge_index`` (2, E) with -1 sentinels (source = frontier-local
-id, target = seed-local id); aggregation uses ``jax.ops.segment_sum`` with an
-overflow bucket for invalid lanes — scatter-free, shape-static, MXU-friendly
-(all matmuls are dense (N, F) x (F, F')).
+id, target = seed-local id).
+
+Two aggregation paths, identical results:
+
+* **dense** (``fanout`` set — every sampler-built Adj): the sampler's edge
+  layout is regular (lane ``s*fanout + k`` targets seed ``s``), so
+  aggregation is a masked ``(num_dst, fanout, F)`` reshape + axis-1
+  reduction — zero scatters. XLA serializes general scatters on TPU
+  (r3 link characterization, docs/TPU_MEASUREMENTS_R3.md), so on the
+  training path this is the difference between VPU-speed reductions and a
+  per-edge loop.
+* **segment** (``fanout=None``): ``jax.ops.segment_sum`` with an overflow
+  bucket for invalid lanes — kept for hand-built/irregular Adjs and as the
+  differential-test oracle.
 """
 
 from __future__ import annotations
@@ -14,7 +25,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["segment_mean_aggregate", "segment_softmax", "gather_src"]
+__all__ = [
+    "segment_mean_aggregate",
+    "segment_softmax",
+    "fanout_softmax",
+    "fanout_sum_aggregate",
+    "gather_src",
+    "zero_scatter_counts",
+    "occurrence_counts",
+]
 
 
 def gather_src(x, src):
@@ -24,16 +43,76 @@ def gather_src(x, src):
     return jnp.where(valid[:, None], h, 0.0), valid
 
 
-def segment_mean_aggregate(messages, dst, valid, num_dst: int):
+def zero_scatter_counts(ids, valid, n: int, dtype=jnp.float32):
+    """Occurrence count of each value in [0, n) among ``ids[valid]`` —
+    a histogram with no scatter: sort (invalid lanes to the sentinel n),
+    then bucket edges via one vectorized binary search. The zero-scatter
+    analogue of ``segment_sum(ones, ids)`` for backends where XLA
+    serializes scatters (same rationale as ops.reindex dedup="scan")."""
+    sv = jnp.sort(jnp.where(valid, ids, n))
+    edges = jnp.searchsorted(sv, jnp.arange(n + 1, dtype=ids.dtype))
+    return (edges[1:] - edges[:-1]).astype(dtype)
+
+
+def occurrence_counts(ids, valid, n: int, dtype=jnp.float32):
+    """Histogram of ``ids[valid]`` over [0, n), strategy picked per
+    platform (the counts-shaped sibling of ops.reindex.resolve_dedup):
+    zero-scatter sort+searchsorted on TPU, one scalar scatter-add
+    elsewhere. ``QUIVER_COUNTS=scan|scatter`` overrides."""
+    import os
+
+    how = os.environ.get("QUIVER_COUNTS", "").strip().lower()
+    if how not in ("scan", "scatter"):
+        how = "scan" if jax.default_backend() == "tpu" else "scatter"
+    if how == "scan":
+        return zero_scatter_counts(ids, valid, n, dtype)
+    return jax.ops.segment_sum(
+        valid.astype(dtype), jnp.where(valid, ids, n), num_segments=n + 1
+    )[:n]
+
+
+def fanout_sum_aggregate(messages, valid, num_dst: int, fanout: int):
+    """Masked dense sum over the regular sampler layout: ``messages``
+    (num_dst*fanout, ...) -> (num_dst, ...), zero scatters. The shared
+    reduction behind every conv family's dense path."""
+    validb = valid.reshape(valid.shape + (1,) * (messages.ndim - 1))
+    m = jnp.where(validb, messages, 0)
+    return m.reshape((num_dst, fanout) + messages.shape[1:]).sum(axis=1)
+
+
+def segment_mean_aggregate(messages, dst, valid, num_dst: int,
+                           fanout: int | None = None):
     """Mean-aggregate edge messages into target nodes.
 
-    Invalid lanes are routed to an overflow segment (index num_dst) and
-    sliced off — the padded-shape analogue of skipping masked edges.
+    With ``fanout`` (regular sampler layout, ``E == num_dst * fanout``) the
+    aggregate is a dense masked reduction; otherwise invalid lanes are
+    routed to an overflow segment (index num_dst) and sliced off — the
+    padded-shape analogue of skipping masked edges.
     """
+    if fanout is not None and messages.shape[0] == num_dst * fanout:
+        total = fanout_sum_aggregate(messages, valid, num_dst, fanout)
+        cnt = valid.reshape(num_dst, fanout).sum(1).astype(messages.dtype)
+        return total / jnp.maximum(cnt, 1.0)[:, None]
     seg = jnp.where(valid, dst, num_dst)
     total = jax.ops.segment_sum(messages, seg, num_segments=num_dst + 1)[:num_dst]
     cnt = jax.ops.segment_sum(valid.astype(messages.dtype), seg, num_segments=num_dst + 1)[:num_dst]
     return total / jnp.maximum(cnt, 1.0)[:, None]
+
+
+def fanout_softmax(logits, valid, num_dst: int, fanout: int):
+    """Dense counterpart of ``segment_softmax`` for the regular layout:
+    per-edge softmax weights over each target's ``fanout`` lanes, no
+    scatters. ``logits`` (E, ...) -> weights (E, ...)."""
+    shape = logits.shape
+    validb = valid.reshape(valid.shape + (1,) * (logits.ndim - 1))
+    neg = jnp.finfo(logits.dtype).min
+    g = jnp.where(validb, logits, neg).reshape((num_dst, fanout) + shape[1:])
+    gmax = g.max(axis=1, keepdims=True)
+    gmax = jnp.where(jnp.isfinite(gmax), gmax, 0.0)
+    expv = jnp.where(g > neg, jnp.exp(g - gmax), 0.0)
+    denom = jnp.maximum(expv.sum(axis=1, keepdims=True),
+                        jnp.finfo(logits.dtype).tiny)
+    return (expv / denom).reshape(shape)
 
 
 def segment_softmax(logits, seg, valid, num_seg: int):

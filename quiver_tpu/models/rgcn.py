@@ -85,7 +85,8 @@ class RGCNLayer(nn.Module):
             src, dst = adj.edge_index
             msgs, valid = gather_src(h, src)
             agg = segment_mean_aggregate(
-                msgs, jnp.clip(dst, 0), valid, layer.dst_caps[d_t]
+                msgs, jnp.clip(dst, 0), valid, layer.dst_caps[d_t],
+                fanout=getattr(adj, "fanout", None),
             )
             out[d_t] = out[d_t] + agg
         return out

@@ -41,10 +41,11 @@ class SAGEConv(nn.Module):
         trained weights on aggregates it computed itself."""
         return self.lin_l(agg) + self.lin_r(x_self)
 
-    def __call__(self, x, edge_index, num_dst: int):
+    def __call__(self, x, edge_index, num_dst: int, fanout: int | None = None):
         src, dst = edge_index[0], edge_index[1]
         msgs, valid = gather_src(x, src)
-        agg = segment_mean_aggregate(msgs, jnp.clip(dst, 0), valid, num_dst)
+        agg = segment_mean_aggregate(msgs, jnp.clip(dst, 0), valid, num_dst,
+                                     fanout=fanout)
         return self.combine(agg, x[:num_dst])
 
 
@@ -70,7 +71,7 @@ class GraphSAGE(nn.Module):
             num_dst = adj.size[1]
             feats = self.num_classes if i == self.num_layers - 1 else self.hidden
             x = SAGEConv(feats, dtype=self.dtype, name=f"conv{i}")(
-                x, adj.edge_index, num_dst
+                x, adj.edge_index, num_dst, getattr(adj, "fanout", None)
             )
             if i != self.num_layers - 1:
                 x = nn.relu(x)

@@ -440,12 +440,17 @@ class DataParallelTrainer:
         model, tx = self.model, self.tx
         S = self.local_batch
         adj_sizes = self._adj_sizes(caps)
+        # deepest-first, matching adj_sizes — restores the regular-layout
+        # fanout the stacked arrays lost, so the step uses the dense
+        # zero-scatter aggregation path
+        fanouts = tuple(self.sampler.sizes)[::-1]
 
         def body(params, opt_state, x, eis, n_id, bsz, labels, key):
             # blocks arrive with a leading length-1 shard dim; squeeze it
             x_b = x[0]
             adjs = [
-                Adj(ei[0], None, sz) for ei, sz in zip(eis, adj_sizes)
+                Adj(ei[0], None, sz, fanout=f)
+                for ei, sz, f in zip(eis, adj_sizes, fanouts)
             ]
             seed_ids = n_id[0][:S]
             lab = labels[jnp.clip(seed_ids, 0)]

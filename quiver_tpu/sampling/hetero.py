@@ -209,7 +209,7 @@ def hetero_multilayer_sample(dev_topos, seeds, num_seeds, key, input_type,
                 # overflow must not leak their edge ids (same rule as the
                 # homogeneous loop)
                 e_id = jnp.where(col >= 0, eids[et], -1).reshape(-1)
-            adjs[et] = Adj(edge_index, e_id, (caps_next[s_t], S))
+            adjs[et] = Adj(edge_index, e_id, (caps_next[s_t], S), fanout=k)
         layers.append(HeteroLayer(adjs, dict(caps_next), dict(caps_prev)))
         frontier_counts.append(layer_uniques)
 

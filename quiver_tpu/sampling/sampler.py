@@ -40,12 +40,20 @@ class Adj:
     ``size`` = (num_source_nodes_cap, num_target_nodes_cap) — static, so it
     survives jit boundaries as pytree metadata (models use it for
     ``num_segments``). Supports 3-tuple unpacking like PyG's Adj.
+
+    ``fanout`` (static, None for hand-built Adjs): when set by the sampler
+    it asserts the REGULAR edge layout — lane ``s*fanout + k`` targets seed
+    ``s`` (or is invalid), so ``E_cap == size[1] * fanout``. Models use it
+    to aggregate with dense (num_dst, fanout) reductions instead of
+    segment scatters, which XLA serializes on TPU.
     """
 
-    def __init__(self, edge_index, e_id, size: tuple[int, int]):
+    def __init__(self, edge_index, e_id, size: tuple[int, int],
+                 fanout: int | None = None):
         self.edge_index = edge_index
         self.e_id = e_id
         self.size = tuple(size)
+        self.fanout = fanout
 
     def __iter__(self):
         return iter((self.edge_index, self.e_id, self.size))
@@ -58,14 +66,15 @@ class Adj:
             jax.device_put(self.edge_index, device),
             None if self.e_id is None else jax.device_put(self.e_id, device),
             self.size,
+            self.fanout,
         )
 
     def tree_flatten(self):
-        return (self.edge_index, self.e_id), (self.size,)
+        return (self.edge_index, self.e_id), (self.size, self.fanout)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0])
+        return cls(children[0], children[1], *aux)
 
 
 class SampleOutput(NamedTuple):
@@ -153,7 +162,7 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
             # re-mask with col: neighbors dropped by frontier-cap overflow
             # must not leak their edge ids
             eids = jnp.where(col >= 0, eids, -1).reshape(-1)
-        adjs.append(Adj(edge_index, eids, (caps[l], S)))
+        adjs.append(Adj(edge_index, eids, (caps[l], S), fanout=k))
         # per-layer tallies in-program: benchmarks and the auto-cap planner
         # read scalars instead of reducing (2, E_cap) arrays on the host
         # path. Tallied POST-reindex (col >= 0), so overflow-dropped

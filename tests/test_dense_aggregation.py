@@ -1,0 +1,127 @@
+"""Dense (fanout) vs segment aggregation parity.
+
+Every sampler-built Adj now carries its static ``fanout``, switching the
+model convs to dense masked (num_dst, fanout) reductions — zero scatters,
+because XLA serializes general scatters on TPU (the same diagnosis behind
+dedup="scan", docs/TPU_MEASUREMENTS_R3.md). These tests pin the invariant
+that the dense path is numerically the segment path: same Adj, same
+params, fanout set vs stripped, outputs must agree to float tolerance for
+all four homogeneous conv families plus the layer primitives.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.sampling.sampler import Adj
+
+
+@pytest.fixture(scope="module")
+def sampled():
+    rng = np.random.default_rng(5)
+    ei = rng.integers(0, 300, size=(2, 4500)).astype(np.int64)
+    topo = CSRTopo(edge_index=ei)
+    s = GraphSageSampler(topo, [7, 5], seed_capacity=64, seed=3)
+    out = s.sample(rng.integers(0, 300, 64))
+    x = rng.normal(size=(out.n_id.shape[0], 32)).astype(np.float32)
+    return out, jnp.asarray(x)
+
+
+def _strip_fanout(adjs):
+    return [Adj(a.edge_index, a.e_id, a.size, fanout=None) for a in adjs]
+
+
+def test_sampler_adjs_carry_fanout(sampled):
+    out, _ = sampled
+    assert [a.fanout for a in out.adjs] == [5, 7]  # deepest first
+    for a in out.adjs:
+        assert a.edge_index.shape[1] == a.size[1] * a.fanout
+
+
+def test_adj_pytree_roundtrip_preserves_fanout(sampled):
+    out, _ = sampled
+    leaves, treedef = jax.tree_util.tree_flatten(out.adjs)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert [a.fanout for a in rebuilt] == [5, 7]
+    assert [a.size for a in rebuilt] == [a.size for a in out.adjs]
+
+
+@pytest.mark.parametrize("family", ["sage", "gcn", "gin", "gat"])
+def test_dense_matches_segment(sampled, family):
+    from quiver_tpu.models import GAT, GCN, GIN, GraphSAGE
+
+    out, x = sampled
+    model = {
+        "sage": lambda: GraphSAGE(hidden=16, num_classes=4, num_layers=2),
+        "gcn": lambda: GCN(hidden=16, num_classes=4, num_layers=2),
+        "gin": lambda: GIN(hidden=16, num_classes=4, num_layers=2),
+        "gat": lambda: GAT(hidden=16, num_classes=4, num_layers=2, heads=2),
+    }[family]()
+    params = model.init(jax.random.PRNGKey(0), x, out.adjs)
+    y_dense = model.apply(params, x, out.adjs)
+    y_seg = model.apply(params, x, _strip_fanout(out.adjs))
+    np.testing.assert_allclose(
+        np.asarray(y_dense), np.asarray(y_seg), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_fanout_softmax_matches_segment_softmax():
+    from quiver_tpu.models.layers import fanout_softmax, segment_softmax
+
+    rng = np.random.default_rng(0)
+    S, K, H = 12, 6, 3
+    logits = jnp.asarray(rng.normal(size=(S * K, H)).astype(np.float32))
+    valid = jnp.asarray(rng.random(S * K) < 0.7)
+    dst = jnp.repeat(jnp.arange(S), K)
+    seg = jnp.where(valid, dst, S)
+    a_seg = segment_softmax(logits, seg, valid, S)
+    a_dense = fanout_softmax(logits, valid, S, K)
+    # compare on valid lanes only (invalid lanes: dense gives 0, segment
+    # gives exp(min)/tiny garbage that callers mask anyway)
+    m = np.asarray(valid)
+    np.testing.assert_allclose(
+        np.asarray(a_dense)[m], np.asarray(a_seg)[m], rtol=1e-5, atol=1e-6
+    )
+    # each target's valid weights sum to 1 (or 0 for all-invalid rows)
+    sums = np.zeros(S)
+    np.add.at(sums, np.asarray(dst)[m], np.asarray(a_dense)[m].sum(-1)[...] / H)
+    assert np.all((np.abs(sums - 1) < 1e-5) | (sums == 0))
+
+
+def test_zero_scatter_counts_matches_bincount():
+    from quiver_tpu.models.layers import zero_scatter_counts
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 50, 1000)
+    valid = rng.random(1000) < 0.8
+    got = np.asarray(zero_scatter_counts(
+        jnp.asarray(ids), jnp.asarray(valid), 50))
+    want = np.bincount(ids[valid], minlength=50)
+    np.testing.assert_array_equal(got, want.astype(np.float32))
+
+
+def test_trainer_fused_step_uses_dense_path(sampled):
+    """The fused-step Adj rebuild must restore fanout (regression: the
+    stacked arrays lose the static metadata)."""
+    import inspect
+
+    from quiver_tpu.parallel import trainer as tr
+
+    src = inspect.getsource(tr)
+    assert "fanout=f" in src  # rebuilt Adjs carry the sampler fanouts
+
+
+def test_occurrence_counts_strategies_agree(monkeypatch):
+    from quiver_tpu.models.layers import occurrence_counts
+
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, 40, 500))
+    valid = jnp.asarray(rng.random(500) < 0.6)
+    monkeypatch.setenv("QUIVER_COUNTS", "scan")
+    a = np.asarray(occurrence_counts(ids, valid, 40))
+    monkeypatch.setenv("QUIVER_COUNTS", "scatter")
+    b = np.asarray(occurrence_counts(ids, valid, 40))
+    np.testing.assert_array_equal(a, b)
