@@ -16,10 +16,34 @@ import re
 
 __all__ = [
     "parse_size_bytes",
+    "resolve_platform_strategy",
     "CachePolicy",
     "SampleMode",
     "SamplerConfig",
 ]
+
+
+def resolve_platform_strategy(env_var: str, choices, tpu_default: str,
+                              other_default: str) -> str:
+    """Shared env-override-then-platform-default resolver.
+
+    Several ops keep two bit-identical implementations whose cost model
+    flips between backends (XLA serializes general scatters on TPU):
+    dedup strategies, occurrence counts, chunked inference aggregation.
+    Each exposes an env var that FORCES a strategy during chip windows; a
+    typo'd force must raise, not silently measure the platform default.
+    """
+    import os
+
+    v = os.environ.get(env_var, "").strip().lower()
+    if v:
+        if v not in choices:
+            raise ValueError(f"{env_var}={v!r} is not one of {tuple(choices)}")
+        return v
+    import jax
+
+    return (tpu_default if jax.default_backend() == "tpu"
+            else other_default)
 
 _SIZE_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([A-Za-z]*)\s*$")
 

@@ -64,29 +64,116 @@ def _edge_chunk(indptr, indices, e0, chunk: int, n: int, host: bool):
 )
 def _accumulate_chunk(acc, x_all, indptr, indices, e0, chunk: int,
                       host: bool):
-    """Scatter-add one edge chunk's source features into the accumulator."""
+    """Scatter-add one edge chunk's source features into the accumulator.
+
+    ``dst`` comes from a searchsorted over ascending edge positions, so it
+    is non-decreasing (the mask bucket n sorts last) — the scatter gets the
+    sorted-indices hint."""
     n = acc.shape[0] - 1  # last row is the mask bucket
     src, dst, _ = _edge_chunk(indptr, indices, e0, chunk, n, host)
-    return acc.at[dst].add(x_all[src])
+    return acc.at[dst].add(x_all[src], indices_are_sorted=True)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=0, static_argnames=("chunk", "span", "host")
+)
+def _accumulate_chunk_scan(acc, x_all, indptr, indices, e0, chunk: int,
+                           span: int, host: bool):
+    """Zero-scatter chunk aggregation (the TPU path, where XLA serializes
+    general scatters — same diagnosis as ops.reindex dedup="scan").
+
+    CSR edge order makes each chunk's destinations a sorted run over a
+    CONTIGUOUS row window, so the segmented sum is exact dense algebra:
+    cumsum the chunk's messages, difference the prefix at each window row's
+    clipped [indptr[v], indptr[v+1]) span, and add the (span, F) result
+    into the accumulator with one dynamic windowed update. ``span`` is a
+    host-precomputed static bound on rows any aligned chunk intersects.
+    """
+    n = acc.shape[0] - 1
+    f = x_all.shape[1]
+    src, _, in_range = _edge_chunk(indptr, indices, e0, chunk, n, host)
+    msgs = jnp.where(in_range[:, None], x_all[src], 0)
+    # Precision: differencing a prefix sum loses ~eps*|prefix| absolutely,
+    # and same-sign features (post-ReLU activations) grow the prefix to
+    # ~chunk*mean — ~10% row-sum error at chunk=2^21. Mean-centering keeps
+    # the prefix at random-walk magnitude (~sqrt(chunk)*sigma) and the
+    # exact (hi-lo)*mean term restores the row sums losslessly. The prefix
+    # is always carried in f32 so bf16 tables keep their low bits too.
+    pdt = jnp.promote_types(msgs.dtype, jnp.float32)
+    mean = msgs.astype(pdt).mean(axis=0)  # (f,)
+    centered = jnp.where(in_range[:, None], msgs.astype(pdt) - mean, 0)
+    prefix = jnp.concatenate(
+        [jnp.zeros((1, f), pdt), jnp.cumsum(centered, axis=0)]
+    )
+    r0 = (jnp.searchsorted(indptr, e0, side="right") - 1).astype(jnp.int32)
+    # any window covering [r0, last-row-in-chunk] works: rows whose spans
+    # end before e0 (or start after the chunk) difference to zero
+    r0 = jnp.clip(r0, 0, max(n + 1 - span, 0))
+    rows = r0 + jnp.arange(span, dtype=jnp.int32)
+    rows_c = jnp.clip(rows, 0, n - 1)
+    lo = jnp.clip(indptr[rows_c] - e0, 0, chunk).astype(jnp.int32)
+    hi = jnp.clip(indptr[rows_c + 1] - e0, 0, chunk).astype(jnp.int32)
+    contrib = prefix[hi] - prefix[lo] + (hi - lo).astype(pdt)[:, None] * mean
+    contrib = jnp.where((rows < n)[:, None], contrib.astype(acc.dtype), 0)
+    window = jax.lax.dynamic_slice(acc, (r0, 0), (span, f))
+    return jax.lax.dynamic_update_slice(acc, window + contrib, (r0, 0))
+
+
+def _chunk_row_span(indptr_host, chunk: int) -> int:
+    """Static bound on the rows any aligned edge chunk intersects —
+    host-side numpy over the CSR offsets (zero-degree runs make this graph-
+    dependent, so it cannot be derived from ``chunk`` alone)."""
+    import numpy as np
+
+    ip = np.asarray(indptr_host)
+    E = int(ip[-1])
+    n = ip.shape[0] - 1
+    if E == 0 or n == 0:
+        return 1
+    starts = np.arange(0, E, chunk)
+    r0 = np.searchsorted(ip, starts, side="right") - 1
+    r1 = np.searchsorted(ip, np.minimum(starts + chunk - 1, E - 1),
+                         side="right") - 1
+    span = int((r1 - r0).max()) + 1
+    return min(-(-span // 8) * 8, n + 1)  # pad to 8 rows, cap at all rows
+
+
+def _use_scan_agg() -> bool:
+    """Platform-resolved chunk-aggregation strategy with env override
+    (``QUIVER_INFER_AGG=scan|scatter``), mirroring resolve_dedup."""
+    from ..core.config import resolve_platform_strategy
+
+    return resolve_platform_strategy(
+        "QUIVER_INFER_AGG", ("scan", "scatter"), tpu_default="scan",
+        other_default="scatter",
+    ) == "scan"
 
 
 def _neighbor_mean_dev(indptr, indices, x_all, chunk: int,
-                       host: bool = False):
+                       host: bool = False, span: int | None = None):
     """full_neighbor_mean body on already-placed CSR arrays.
 
     Output row count comes from ``indptr`` (not ``x_all``), so rectangular
     relation CSRs — rows in a dst-type id space, columns in a src-type id
-    space (hetero RelCSR) — aggregate correctly too.
+    space (hetero RelCSR) — aggregate correctly too. ``span`` (static,
+    from _chunk_row_span) selects the zero-scatter scan path; None keeps
+    the scatter path.
     """
     f = x_all.shape[1]
     n_out = indptr.shape[0] - 1
     E = indices.shape[0]
     acc = jnp.zeros((n_out + 1, f), x_all.dtype)  # +1 = masked-lane bucket
     for e0 in range(0, max(E, 1), chunk):
-        acc = _accumulate_chunk(
-            acc, x_all, indptr, indices,
-            jnp.asarray(e0, indptr.dtype), chunk, host,
-        )
+        if span is not None:
+            acc = _accumulate_chunk_scan(
+                acc, x_all, indptr, indices,
+                jnp.asarray(e0, indptr.dtype), chunk, span, host,
+            )
+        else:
+            acc = _accumulate_chunk(
+                acc, x_all, indptr, indices,
+                jnp.asarray(e0, indptr.dtype), chunk, host,
+            )
     deg = jnp.maximum(jnp.diff(indptr).astype(x_all.dtype), 1.0)
     return acc[:n_out] / deg[:, None]
 
@@ -115,8 +202,9 @@ def full_neighbor_mean(topo, x_all, chunk: int = 1 << 21,
     zeros, matching segment_mean_aggregate's empty-segment convention.
     """
     indptr, indices, host = _place(topo, mode)
+    span = _chunk_row_span(topo.indptr, chunk) if _use_scan_agg() else None
     return _neighbor_mean_dev(indptr, indices, jnp.asarray(x_all), chunk,
-                              host)
+                              host, span=span)
 
 
 def _edge_logits(alpha_src, alpha_dst, src, dst, negative_slope):
@@ -225,6 +313,7 @@ def gcn_layerwise_inference(model, params, topo, x_all,
     """
     x = jnp.asarray(x_all)
     indptr, indices, host = _place(topo, mode)
+    span = _chunk_row_span(topo.indptr, chunk) if _use_scan_agg() else None
     deg = jnp.diff(indptr).astype(x.dtype)
     inv_s = jax.lax.rsqrt(deg + 1.0)  # self-loop-augmented degrees
     for i in range(model.num_layers):
@@ -232,7 +321,7 @@ def gcn_layerwise_inference(model, params, topo, x_all,
             model.num_classes if i == model.num_layers - 1 else model.hidden
         )
         h = x * inv_s[:, None]
-        agg = _neighbor_mean_dev(indptr, indices, h, chunk, host)
+        agg = _neighbor_mean_dev(indptr, indices, h, chunk, host, span=span)
         agg = (agg * deg[:, None] + h) * inv_s[:, None]
         conv = GCNConv(feats)
         x = conv.apply(
@@ -254,6 +343,7 @@ def gin_layerwise_inference(model, params, topo, x_all,
 
     x = jnp.asarray(x_all)
     indptr, indices, host = _place(topo, mode)
+    span = _chunk_row_span(topo.indptr, chunk) if _use_scan_agg() else None
     deg = jnp.diff(indptr).astype(x.dtype)
     for i in range(model.num_layers):
         last = i == model.num_layers - 1
@@ -262,7 +352,8 @@ def gin_layerwise_inference(model, params, topo, x_all,
             mlp_hidden=model.hidden,
             train_eps=model.train_eps,
         )
-        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host)
+        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host,
+                                 span=span)
         agg = agg * deg[:, None]
         p_i = {"params": params[f"conv{i}"]}
         eps = p_i["params"]["eps"] if model.train_eps else conv.eps_init
@@ -301,6 +392,11 @@ def rgcn_layerwise_inference(model, params, topo, x_dict,
     placed = {
         et: _place(rel, mode) for et, rel in topo.relations.items()
     }
+    scan_agg = _use_scan_agg()
+    spans = {
+        et: _chunk_row_span(rel.indptr, chunk) if scan_agg else None
+        for et, rel in topo.relations.items()
+    }
     for i in range(model.num_layers):
         p = params[f"conv{i}"]
         # the sampled model creates weights only for types/relations active
@@ -331,7 +427,7 @@ def rgcn_layerwise_inference(model, params, topo, x_dict,
             h = x_dict[s_t] @ wmat
             indptr, indices, host = placed[et]
             out[d_t] = out[d_t] + _neighbor_mean_dev(
-                indptr, indices, h, chunk, host
+                indptr, indices, h, chunk, host, span=spans[et]
             )
         if i != model.num_layers - 1:
             out = {t: jax.nn.relu(v) for t, v in out.items()}
@@ -358,11 +454,13 @@ def sage_layerwise_inference(model, params, topo, x_all,
     x = jnp.asarray(x_all)
     # place the (possibly multi-GB) CSR arrays once, not once per layer
     indptr, indices, host = _place(topo, mode)
+    span = _chunk_row_span(topo.indptr, chunk) if _use_scan_agg() else None
     for i in range(model.num_layers):
         feats = (
             model.num_classes if i == model.num_layers - 1 else model.hidden
         )
-        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host)
+        agg = _neighbor_mean_dev(indptr, indices, x, chunk, host,
+                                 span=span)
         conv = SAGEConv(feats)
         x = conv.apply(
             {"params": params[f"conv{i}"]}, agg, x, method=SAGEConv.combine

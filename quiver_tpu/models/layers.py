@@ -59,11 +59,12 @@ def occurrence_counts(ids, valid, n: int, dtype=jnp.float32):
     platform (the counts-shaped sibling of ops.reindex.resolve_dedup):
     zero-scatter sort+searchsorted on TPU, one scalar scatter-add
     elsewhere. ``QUIVER_COUNTS=scan|scatter`` overrides."""
-    import os
+    from ..core.config import resolve_platform_strategy
 
-    how = os.environ.get("QUIVER_COUNTS", "").strip().lower()
-    if how not in ("scan", "scatter"):
-        how = "scan" if jax.default_backend() == "tpu" else "scatter"
+    how = resolve_platform_strategy(
+        "QUIVER_COUNTS", ("scan", "scatter"), tpu_default="scan",
+        other_default="scatter",
+    )
     if how == "scan":
         return zero_scatter_counts(ids, valid, n, dtype)
     return jax.ops.segment_sum(
@@ -107,8 +108,9 @@ def fanout_softmax(logits, valid, num_dst: int, fanout: int):
     validb = valid.reshape(valid.shape + (1,) * (logits.ndim - 1))
     neg = jnp.finfo(logits.dtype).min
     g = jnp.where(validb, logits, neg).reshape((num_dst, fanout) + shape[1:])
-    gmax = g.max(axis=1, keepdims=True)
-    gmax = jnp.where(jnp.isfinite(gmax), gmax, 0.0)
+    gmax = g.max(axis=1, keepdims=True)  # finite even for all-invalid rows
+    # all-invalid rows are handled by the g > neg mask (their exp(0) lanes
+    # are zeroed), not by the max
     expv = jnp.where(g > neg, jnp.exp(g - gmax), 0.0)
     denom = jnp.maximum(expv.sum(axis=1, keepdims=True),
                         jnp.finfo(logits.dtype).tiny)

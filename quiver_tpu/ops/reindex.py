@@ -52,21 +52,12 @@ def resolve_dedup(dedup: str) -> str:
         raise ValueError(
             f"dedup must be 'auto', 'sort', 'map', or 'scan', got {dedup!r}"
         )
-    import os
+    from ..core.config import resolve_platform_strategy
 
-    env = os.environ.get("QUIVER_DEDUP", "").strip().lower()
-    if env:
-        if env not in DEDUP_STRATEGIES:
-            # the env var exists to FORCE a strategy during chip windows;
-            # a typo silently measuring the platform default would be
-            # recorded as the forced strategy — fail instead
-            raise ValueError(
-                f"QUIVER_DEDUP={env!r} is not one of {DEDUP_STRATEGIES}"
-            )
-        return env
-    import jax
-
-    return "scan" if jax.devices()[0].platform == "tpu" else "map"
+    return resolve_platform_strategy(
+        "QUIVER_DEDUP", DEDUP_STRATEGIES, tpu_default="scan",
+        other_default="map",
+    )
 
 
 def inverse_permutation(p):

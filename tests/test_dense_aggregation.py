@@ -103,15 +103,28 @@ def test_zero_scatter_counts_matches_bincount():
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
-def test_trainer_fused_step_uses_dense_path(sampled):
-    """The fused-step Adj rebuild must restore fanout (regression: the
-    stacked arrays lose the static metadata)."""
-    import inspect
+def test_trainer_fused_step_rebuilds_fanout_correctly():
+    """The fused-step Adj rebuild must restore each layer's OWN fanout
+    (regression: stacked arrays lose the static metadata, and a wrong
+    pairing silently falls back to the scatter path)."""
+    rng = np.random.default_rng(7)
+    topo = CSRTopo(edge_index=rng.integers(0, 400, (2, 6000)).astype(np.int64))
+    sampler = GraphSageSampler(topo, [9, 4], seed_capacity=32, seed=0)
+    out = sampler.sample(np.arange(32))
+    caps = tuple(a.size[0] for a in out.adjs)[::-1]  # seeds-outward order
 
-    from quiver_tpu.parallel import trainer as tr
+    # replicate _compiled_step's rebuild: deepest-first sizes + caps
+    from quiver_tpu.parallel.trainer import DataParallelTrainer
 
-    src = inspect.getsource(tr)
-    assert "fanout=f" in src  # rebuilt Adjs carry the sampler fanouts
+    adj_sizes = DataParallelTrainer._adj_sizes(
+        type("T", (), {"local_batch": 32})(), caps
+    )
+    fanouts = tuple(sampler.sizes)[::-1]
+    for a, sz, f in zip(out.adjs, adj_sizes, fanouts):
+        rebuilt = Adj(a.edge_index, None, sz, fanout=f)
+        # the dense-path gate must hold for every rebuilt layer
+        assert rebuilt.edge_index.shape[1] == rebuilt.size[1] * rebuilt.fanout
+        assert rebuilt.size == a.size and rebuilt.fanout == a.fanout
 
 
 def test_occurrence_counts_strategies_agree(monkeypatch):
