@@ -16,6 +16,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "distributed_worker.py")
 
@@ -26,9 +28,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_job():
+@pytest.mark.parametrize("nprocs", [
+    2,
+    pytest.param(3, marks=pytest.mark.skipif(
+        (os.cpu_count() or 1) < 4,
+        reason="3-process rendezvous thrashes below 4 cores",
+    )),
+])
+def test_multi_process_distributed_job(nprocs):
     port = _free_port()
-    nprocs = 2
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers set their own device count
     procs = [
