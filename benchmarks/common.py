@@ -356,15 +356,23 @@ def _select_prng(platform: str) -> str | None:
 
     import jax
 
-    impl = os.environ.get("QUIVER_PRNG")
-    if impl in (None, "") and platform == "tpu":
-        impl = "rbg"
-    if impl in (None, "", "default", "threefry"):
+    forced = os.environ.get("QUIVER_PRNG", "").strip().lower()
+    known = ("threefry", "threefry2x32", "rbg", "unsafe_rbg", "default")
+    if forced and forced not in known:
+        # the env var FORCES an impl during chip windows; a typo silently
+        # measuring the default would be recorded as the forced impl —
+        # same rule as resolve_platform_strategy
+        raise ValueError(f"QUIVER_PRNG={forced!r} is not one of {known}")
+    impl = forced or ("rbg" if platform == "tpu" else "")
+    if impl in ("", "default", "threefry", "threefry2x32"):
         return None
     try:
         jax.config.update("jax_default_prng_impl", impl)
         return impl
-    except Exception as e:  # noqa: BLE001 — a perf knob must not kill a run
+    except Exception as e:  # noqa: BLE001 — an UNFORCED perf default must
+        # not kill a run (e.g. a backend without the rbg impl)
+        if forced:
+            raise
         log(f"prng impl {impl!r} not applied: {e}")
         return None
 

@@ -40,6 +40,7 @@ from .feature import (
 )
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
+from ..ops.reindex import inverse_permutation_gather
 from ..ops.sample import staged_gather
 from ..utils.trace import get_logger
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
@@ -134,10 +135,18 @@ class ShardedTensor(KernelChoice):
         # position of each sorted lane within its bucket
         start = jnp.searchsorted(sorted_owner, jnp.arange(F, dtype=owner.dtype))
         slot = jnp.arange(L, dtype=jnp.int32) - start[sorted_owner]
-        # send buckets (F, L): bucket f holds my requests owned by shard f;
-        # empty lanes carry -1
-        send = jnp.full((F, L), -1, sorted_ids.dtype)
-        send = send.at[sorted_owner, slot].set(sorted_ids, mode="drop")
+        # send buckets (F, L): bucket f holds my requests owned by shard f
+        # (a contiguous run of the sorted view), empty lanes carry -1.
+        # Built by GATHER, not scatter — XLA serializes general scatters on
+        # TPU, and this sits on the per-batch routed hot path.
+        ends = jnp.concatenate(
+            [start[1:], jnp.full((1,), L, start.dtype)]
+        )
+        j = jnp.arange(L, dtype=jnp.int32)[None, :]
+        pos = jnp.clip(start[:, None] + j, 0, L - 1)
+        send = jnp.where(
+            j < (ends - start)[:, None], sorted_ids[pos], -1
+        )
 
         # hop 1: bucket f goes to shard f; recv[g] = shard g's requests to me
         recv = jax.lax.all_to_all(
@@ -157,7 +166,9 @@ class ShardedTensor(KernelChoice):
         ).reshape(F, L, -1)
         # back[f, slot] = row for my sorted request (bucket f, position slot)
         rows_sorted = back[sorted_owner, slot]
-        rows = jnp.zeros_like(rows_sorted).at[order].set(rows_sorted)
+        # undo the owner sort with a gather through the inverse permutation
+        # (argsort of int lanes) instead of scattering L x F_dim rows
+        rows = rows_sorted[inverse_permutation_gather(order)]
         return jnp.where(valid[:, None], rows, 0)
 
     def _gather_fn(self, padded_len: int, dtype, routed: bool = False):

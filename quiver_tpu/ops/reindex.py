@@ -20,6 +20,7 @@ __all__ = [
     "masked_unique",
     "reindex_layer",
     "inverse_permutation",
+    "inverse_permutation_gather",
     "complete_permutation",
     "resolve_dedup",
 ]
@@ -66,6 +67,14 @@ def inverse_permutation(p):
     for_each."""
     n = p.shape[0]
     return jnp.zeros(n, p.dtype).at[p].set(jnp.arange(n, dtype=p.dtype))
+
+
+def inverse_permutation_gather(p):
+    """The zero-scatter sibling of :func:`inverse_permutation`: argsort of
+    a permutation IS its inverse. Costs a sort instead of a scatter — the
+    right trade on backends where XLA serializes scatters (shared by the
+    dedup scan strategy and the routed feature gather)."""
+    return jnp.argsort(p).astype(jnp.int32)
 
 
 def complete_permutation(p, n: int):
@@ -171,7 +180,7 @@ def masked_unique(ids, valid, size: int, num_forced: int = 0,
             )
             # back to original positions via the inverse permutation, built
             # by sorting the permutation instead of scattering into it
-            rep_pos = rep_pos_sorted[jnp.argsort(order).astype(jnp.int32)]
+            rep_pos = rep_pos_sorted[inverse_permutation_gather(order)]
         else:
             run_id = jnp.cumsum(first.astype(jnp.int32)) - 1
             # representative position scattered per run

@@ -103,6 +103,13 @@ class TestPrngSelection:
         finally:
             self._restore()
 
+    def test_typod_force_raises(self, monkeypatch):
+        import pytest
+
+        monkeypatch.setenv("QUIVER_PRNG", "rgb")  # the classic transposition
+        with pytest.raises(ValueError, match="QUIVER_PRNG"):
+            common._select_prng("tpu")
+
 
 def _job(key, value=1.0, error=None, smoke=False, records=None):
     if records is None:
