@@ -43,6 +43,13 @@ def main():
         "seed_sharding='all' trainer's gather",
     )
     p.add_argument(
+        "--routed-alpha", type=float, default=0.0, metavar="A",
+        help="capped-bucket factor for --routed: per-destination bucket "
+        "capacity ceil(A*L/F), so each all_to_all hop moves ~A*L lanes "
+        "instead of the exact-safe F*L; overflow is fallback-served and "
+        "counted. 0 = uncapped full-length buckets",
+    )
+    p.add_argument(
         "--dtype", default="f32", choices=["f32", "bf16", "int8"],
         help="feature storage dtype: bf16 halves row bytes; int8 "
         "(per-row absmax quantization, dequant on gather) quarters them",
@@ -86,6 +93,7 @@ def _body(args):
             csr_topo=topo,
             kernel=args.kernel,
             dtype=dtype,
+            routed_alpha=args.routed_alpha or 2.0,
         ).from_cpu_tensor(feat)
     del feat
 
@@ -98,11 +106,17 @@ def _body(args):
         for _ in range(min(args.iters, 8))  # reuse id sets; drawing is slow
     ]
 
+    # capped-bucket routing: --routed-alpha > 0 pins cap = ceil(A*L/F) as
+    # an EXPLICIT capacity (not "auto") so mid-run overflow is
+    # fallback-served and reported rather than silently re-planned — the
+    # emitted comm model must match what actually ran
+    routed_cap, routed_model = _routed_comm_model(args, store)
+
     def fetch(ids):
         if args.routed:
             if args.policy != "shard":
                 raise ValueError("--routed requires --policy shard")
-            return store.gather(ids, routed=True)
+            return store.gather(ids, routed=True, routed_cap=routed_cap)
         return store[ids]
 
     t0 = time.time()
@@ -132,7 +146,8 @@ def _body(args):
         # guarded: a stream failure must not discard the measured per-call
         # number (run_guarded would retry the whole body and degrade)
         try:
-            _stream_gbps(args, store, batches, stored_itemsize, row_overhead)
+            _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
+                         routed_cap=routed_cap, routed_model=routed_model)
         except Exception as e:  # noqa: BLE001
             log(f"stream measure failed (per-call record stands): "
                 f"{type(e).__name__}: {str(e)[:200]}")
@@ -149,11 +164,60 @@ def _body(args):
         gather_batch=args.gather_batch,
         dispatch="percall",
         routed=getattr(args, "routed", False),
+        **_routed_extras(store, routed_model),
     )
 
 
+def _routed_comm_model(args, store):
+    """Per-device comm-volume model of the routed hot-tier gather.
+
+    Lanes (feature-row slots) each all_to_all hop carries per device:
+    ``F * L`` for the exact-safe full-length buckets, ``F * cap`` for
+    capped buckets (``cap = ceil(alpha * L / F)`` => ``~alpha * L``), where
+    L is the per-device request length after padding. The model is exact —
+    bucket shapes are static — and the measured overflow count (fallback-
+    served lanes) rides alongside it in the record.
+
+    Returns (explicit_cap_or_None, model_extras_dict_or_None).
+    """
+    if not getattr(args, "routed", False) or store.hot is None:
+        return None, None
+    import jax
+
+    n_dev = len(jax.devices())
+    batch = args.gather_batch
+    local_len = (batch + (-batch) % n_dev) // n_dev
+    F = store.hot.num_shards
+    uncapped_lanes = F * local_len
+    if not args.routed_alpha:
+        return None, {
+            "lanes_per_hop": uncapped_lanes,
+            "lanes_per_hop_uncapped": uncapped_lanes,
+            "comm_reduction": 1.0,
+        }
+    cap = store.hot.routed_cap(local_len, args.routed_alpha)
+    return cap, {
+        "routed_alpha": args.routed_alpha,
+        "routed_cap": cap,
+        "lanes_per_hop": F * cap,
+        "lanes_per_hop_uncapped": uncapped_lanes,
+        "comm_reduction": round(uncapped_lanes / (F * cap), 2),
+    }
+
+
+def _routed_extras(store, routed_model):
+    """Ledger extras for a routed run: the comm model + the measured
+    fallback-served overflow count of the last gather."""
+    if routed_model is None:
+        return {}
+    extras = dict(routed_model)
+    ov = store.last_routed_overflow
+    extras["routed_overflow"] = 0 if ov is None else int(ov)
+    return extras
+
+
 def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
-                 reps: int = 3):
+                 reps: int = 3, routed_cap=None, routed_model=None):
     """GB/s over a fused id stream: ONE compiled program scans pre-staged
     device id batches; a full-row checksum in the carry keeps every gathered
     column live (summing a slice would let XLA narrow the gather). Timed
@@ -177,7 +241,10 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
     @jax.jit
     def stream(ids_all):
         def step(carry, ids):
-            rows = store.gather(ids, routed=True) if routed else store[ids]
+            rows = (
+                store.gather(ids, routed=True, routed_cap=routed_cap)
+                if routed else store[ids]
+            )
             return carry + jnp.sum(rows.astype(jnp.float32)), None
         total, _ = lax.scan(step, jnp.float32(0), ids_all)
         return total
@@ -214,6 +281,7 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
         stream_batches=args.stream,
         routed=getattr(args, "routed", False),
         **extras,
+        **_routed_extras(store, routed_model),
     )
 
 

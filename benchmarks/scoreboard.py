@@ -98,7 +98,15 @@ JOBS = [
     ("feature-shard-routed", "benchmarks.bench_feature",
      ["--policy", "shard", "--routed", "--stream", "32"],
      "owner-routed all_to_all hot gather over the mesh feature axis "
-     "(seed_sharding='all' trainer gather), dispatch-clean stream mode"),
+     "(seed_sharding='all' trainer gather), dispatch-clean stream mode; "
+     "UNCAPPED full-length buckets (F*L lanes/hop) — the capped row's "
+     "comm-volume baseline"),
+    ("feature-shard-routed-capped", "benchmarks.bench_feature",
+     ["--policy", "shard", "--routed", "--routed-alpha", "2",
+      "--stream", "32"],
+     "capped-bucket routed gather: cap=ceil(2*L/F) per destination, "
+     "~2*L lanes/hop vs the uncapped row's F*L (lanes_per_hop + measured "
+     "overflow in the record; overflow lanes are fallback-served)"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))

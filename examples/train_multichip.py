@@ -61,6 +61,11 @@ def main():
                    help="'all': every device a data worker; the sharded "
                    "gather owner-routes via all_to_all (recommended when "
                    "feature-axis > 1 — removes the redundant-sampling cost)")
+    p.add_argument("--routed-alpha", type=float, default=2.0,
+                   help="capped-bucket factor for the routed gather "
+                   "(seed-sharding=all): each all_to_all hop moves "
+                   "~alpha*L lanes instead of F*L; overflow is "
+                   "fallback-served and reported. 0 = uncapped")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -86,7 +91,8 @@ def main():
                       num_layers=len(args.fanout))
     trainer = DistributedTrainer(mesh, sampler, feature, model,
                                  optax.adam(1e-3), local_batch=args.local_batch,
-                                 seed_sharding=args.seed_sharding)
+                                 seed_sharding=args.seed_sharding,
+                                 routed_alpha=args.routed_alpha or None)
     params, opt_state = trainer.init(jax.random.PRNGKey(args.seed))
 
     # global batch split over the data axis = train_idx.split(world)[rank]
@@ -108,6 +114,10 @@ def main():
         f"done: {args.steps} steps, global batch {global_batch} "
         f"({per_step*1e3:.1f} ms/step, {global_batch/per_step:,.0f} seeds/s)"
     )
+    if args.seed_sharding == "all" and trainer.last_routed_overflow is not None:
+        print(f"routed overflow (last step): "
+              f"{int(trainer.last_routed_overflow)} lanes fallback-served "
+              f"(grow --routed-alpha if persistent)")
 
 
 if __name__ == "__main__":

@@ -18,9 +18,21 @@ share no ICI would sit on different meshes entirely.
 ``ShardedTensor`` is the generic row-sharded 2-D table (reference
 ShardTensor parity); ``ShardedFeature`` layers feature_order translation and
 the cold host tier on top (reference Feature with p2p_clique_replicate).
+
+When every feature-group member requests its OWN id set (routed mode, the
+seed_sharding="all" trainer), requests are routed to their owning shard
+over two ``all_to_all`` hops. Buckets are CAPPED by default: capacity
+``ceil(alpha * L / F)`` per destination, so each hop moves ``alpha * L``
+lanes instead of the exact-safe worst case ``F * L`` — the comm volume no
+longer inflates with the feature-axis width. Per-bucket overflow is
+detected in-program and served through a psum fallback (never silent,
+never wrong), counted, and surfaced so callers and the auto-tuner can grow
+the cap across batches. See ``ShardedTensor.routed_gather``.
 """
 
 from __future__ import annotations
+
+import math
 
 import numpy as np
 
@@ -43,7 +55,7 @@ from ..core.topology import CSRTopo
 from ..ops.reindex import inverse_permutation_gather
 from ..ops.sample import staged_gather
 from ..utils.trace import get_logger
-from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS
+from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..utils.reorder import reorder_by_degree
 
 __all__ = ["ShardedTensor", "ShardedFeature"]
@@ -58,11 +70,27 @@ class ShardedTensor(KernelChoice):
     (shard_tensor.py:55-76).
     """
 
-    def __init__(self, mesh: Mesh, axis: str = FEATURE_AXIS, kernel: str = "auto"):
+    def __init__(self, mesh: Mesh, axis: str = FEATURE_AXIS, kernel: str = "auto",
+                 routed_alpha: float = 2.0):
         self.mesh = mesh
         self.axis = axis
         self.num_shards = mesh.shape[axis]
         self._kernel = validate_gather_kernel(kernel)
+        # capped-bucket routed gather: per-destination bucket capacity
+        # ceil(routed_alpha * L / F). alpha=2 leaves 2x headroom over a
+        # uniform owner distribution — degree-ordered hot rows concentrate
+        # on shard 0 (reorder_by_degree's partial shuffle spreads them, but
+        # skew survives), so 1.0 would overflow routinely. Grown by
+        # _maybe_grow_routed_alpha when a batch overflows (fallback-served,
+        # never wrong — just slower); alpha >= F means full-length buckets,
+        # i.e. the exact-safe uncapped path.
+        if routed_alpha <= 0:
+            raise ValueError(f"routed_alpha must be > 0, got {routed_alpha}")
+        self.routed_alpha = float(routed_alpha)
+        # device scalar from the last capped routed gather (None before
+        # any); read lazily — int() forces a sync, so consumers (the
+        # auto-tuner, benchmarks, trace metadata) pull it after the batch.
+        self.last_routed_overflow = None
         self.table = None
         self.rows_per_shard = 0
         self.num_rows = 0
@@ -100,7 +128,44 @@ class ShardedTensor(KernelChoice):
         rows = _hot_gather_fn(local_table, self.kernel)(local_idx)
         return jnp.where(mine[:, None], rows, 0)
 
-    def routed_gather(self, local_table, ids):
+    def routed_cap(self, length: int, alpha: float | None = None) -> int:
+        """Capped-bucket capacity for a per-device request length ``L``:
+        ``cap = ceil(alpha * L / F)``, clamped to [1, L]. ``cap == L``
+        degenerates to the exact-safe full-length buckets (no fallback
+        machinery is traced then)."""
+        a = self.routed_alpha if alpha is None else float(alpha)
+        if a <= 0:
+            raise ValueError(f"alpha must be > 0, got {a}")
+        cap = math.ceil(a * length / max(self.num_shards, 1))
+        return max(1, min(int(cap), int(length)))
+
+    def _maybe_grow_routed_alpha(self) -> None:
+        """Auto-tuner step for eager capped gathers: if the PREVIOUS capped
+        batch overflowed its buckets, double ``routed_alpha`` (capped at F
+        — full-length buckets) before planning this batch's cap. Reading
+        the stashed count is cheap: the batch that produced it has long
+        since completed."""
+        ov = self.last_routed_overflow
+        if ov is None:
+            return
+        self.last_routed_overflow = None
+        try:
+            count = int(ov)
+        except Exception:  # noqa: BLE001 — a deleted/donated buffer must
+            return  # not break the next gather
+        if count <= 0:
+            return
+        old = self.routed_alpha
+        self.routed_alpha = min(old * 2.0, float(self.num_shards))
+        if self.routed_alpha != old:
+            get_logger("feature").info(
+                "routed gather: %d lanes overflowed their buckets "
+                "(fallback-served); growing alpha %.2f -> %.2f",
+                count, old, self.routed_alpha,
+            )
+
+    def routed_gather(self, local_table, ids, cap: int | None = None,
+                      with_overflow: bool = False):
         """Per-device body: serve a DIFFERENT id set per feature-group
         member by routing requests to their owning shard and rows back —
         two ``all_to_all`` hops over the feature axis.
@@ -112,20 +177,63 @@ class ShardedTensor(KernelChoice):
         a full data worker over its own seed block while the table stays
         sharded (see docs/Introduction.md "Cost of redundant sampling").
 
-        Static shapes: each of the F destination buckets is padded to the
-        full request length L (worst case all ids on one shard — the exact-
-        safe choice; degree-ordered hot rows concentrate on shard 0, and
-        the partial shuffle in reorder_by_degree is what spreads them).
-        Memory/comm is therefore F x L lanes per hop; use psum
-        ``local_gather`` when the group shares one id set.
+        Comm model (L = per-device request length, F = feature-axis size):
+
+        * ``cap=None`` — exact-safe full-length buckets: every destination
+          bucket is padded to L (worst case all ids on one shard), so each
+          hop moves ``F x L`` row lanes regardless of actual traffic.
+        * ``cap=c`` (capped-bucket mode, ``c = ceil(alpha*L/F)`` from
+          :meth:`routed_cap`) — each hop moves ``F x c ~= alpha*L`` lanes.
+          Per-bucket overflow (more than ``c`` of my requests owned by one
+          shard) is DETECTED in-program, never silent: overflowed lanes
+          are served through a psum fallback (all_gather the <= L-c
+          overflow ids over the feature axis, each shard contributes the
+          rows it owns, psum returns them everywhere) gated behind a
+          ``lax.cond`` whose predicate is the feature-group psum of the
+          overflow count — uniform across the participants, so the
+          collective-inside-cond is deadlock-free, and a non-overflowing
+          batch pays ZERO fallback comm. The total overflow across all
+          buckets is <= L - c (at most L valid lanes, each overflowing
+          bucket keeps c of them), so the (L-c,) fallback buffer is
+          exact-safe.
+
+        Results are bit-identical between the two modes: capped routing
+        moves the same table rows, just in smaller buckets, and fallback
+        lanes receive exactly the rows the uncapped path would have
+        fetched. Use psum ``local_gather`` instead when the feature group
+        shares one id set.
 
         ``ids`` may contain invalid lanes as any negative value; their rows
-        return zero.
+        return zero. With ``with_overflow=True`` returns ``(rows, count)``
+        where ``count`` is the feature-group total of fallback-served lanes
+        (an int32 scalar, identical on every member; always 0 when
+        ``cap=None``).
         """
         F = self.num_shards
         L = ids.shape[0]
+        if cap is not None:
+            cap = int(cap)
+            if cap < 1:
+                raise ValueError(f"cap must be >= 1, got {cap}")
+            if cap >= L:
+                cap = None  # full-length buckets ARE the uncapped path
         valid = ids >= 0
         safe = jnp.where(valid, ids, 0)
+
+        if cap is None:
+            rows = self._routed_uncapped(local_table, safe, valid)
+            if with_overflow:
+                return rows, jnp.zeros((), jnp.int32)
+            return rows
+        rows, overflow = self._routed_capped(local_table, safe, valid, cap)
+        if with_overflow:
+            return rows, overflow
+        return rows
+
+    def _routed_uncapped(self, local_table, safe, valid):
+        """Exact-safe full-length buckets: F x L lanes per hop."""
+        F = self.num_shards
+        L = safe.shape[0]
         owner = jnp.clip(safe // self.rows_per_shard, 0, F - 1)
 
         # stable bucket order: sort my requests by owning shard
@@ -171,7 +279,108 @@ class ShardedTensor(KernelChoice):
         rows = rows_sorted[inverse_permutation_gather(order)]
         return jnp.where(valid[:, None], rows, 0)
 
-    def _gather_fn(self, padded_len: int, dtype, routed: bool = False):
+    def _routed_capped(self, local_table, safe, valid, cap: int):
+        """Capped buckets (F x cap lanes per hop) + gated psum fallback.
+
+        Returns (rows, overflow_count) — see :meth:`routed_gather` for the
+        comm model and the <= L-cap overflow-budget argument.
+        """
+        F = self.num_shards
+        L = safe.shape[0]
+        my = jax.lax.axis_index(self.axis)
+        gather_rows = _hot_gather_fn(local_table, self.kernel)
+
+        # invalid lanes go to a sentinel bucket F past the real ones: they
+        # are never routed at all (the uncapped path routes them as row-0
+        # requests — harmless there, but here they would eat bucket
+        # capacity and fake overflow)
+        owner = jnp.where(
+            valid, jnp.clip(safe // self.rows_per_shard, 0, F - 1), F
+        )
+        order = jnp.argsort(owner, stable=True)
+        sorted_ids = safe[order]
+        sorted_owner = owner[order]
+        sorted_valid = valid[order]
+        bounds = jnp.searchsorted(
+            sorted_owner, jnp.arange(F + 1, dtype=sorted_owner.dtype)
+        )
+        start, ends = bounds[:F], bounds[1:]
+        counts = ends - start
+        owner_c = jnp.clip(sorted_owner, 0, F - 1)
+        slot = jnp.arange(L, dtype=jnp.int32) - start[owner_c]
+
+        # send buckets (F, cap): the first cap requests per destination
+        j = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        pos = jnp.clip(start[:, None] + j, 0, L - 1)
+        send = jnp.where(
+            j < jnp.minimum(counts, cap)[:, None], sorted_ids[pos], -1
+        )
+
+        # hop 1 + serve + hop 2, exactly as uncapped but cap-wide
+        recv = jax.lax.all_to_all(
+            send, self.axis, split_axis=0, concat_axis=0, tiled=False
+        ).reshape(F, cap)
+        rvalid = recv >= 0
+        local_idx = jnp.where(rvalid, recv - my * self.rows_per_shard, 0)
+        served = gather_rows(local_idx.reshape(-1)).reshape(F, cap, -1)
+        served = jnp.where(rvalid[:, :, None], served, 0)
+        back = jax.lax.all_to_all(
+            served, self.axis, split_axis=0, concat_axis=0, tiled=False
+        ).reshape(F, cap, -1)
+        main_rows = back[owner_c, jnp.clip(slot, 0, cap - 1)]
+
+        # overflowed lanes: valid requests past their bucket's capacity
+        ov_mask = sorted_valid & (slot >= cap)
+        ov_local = jnp.sum(ov_mask.astype(jnp.int32))
+        overflow = jax.lax.psum(ov_local, self.axis)
+        L_ov = L - cap  # exact-safe budget (proof in routed_gather's doc)
+        if L_ov == 0:
+            rows_sorted = main_rows
+        else:
+            dim = local_table.shape[1]
+            # compact my overflow ids to the static budget, overflow lanes
+            # first in sorted order (False < True, stable)
+            take = jnp.argsort(~ov_mask, stable=True)[:L_ov]
+            ov_ids = jnp.where(
+                jnp.arange(L_ov, dtype=jnp.int32) < ov_local,
+                sorted_ids[take], -1,
+            )
+
+            def _fallback(ov_ids):
+                # psum local_gather over the feature group: everyone sees
+                # everyone's overflow ids (cheap — int lanes, no rows),
+                # each shard contributes the rows it owns, the psum hands
+                # every member the full answer and it keeps its own slice
+                allov = jax.lax.all_gather(
+                    ov_ids, self.axis, tiled=False
+                ).reshape(F, L_ov)
+                gvalid = allov >= 0
+                gsafe = jnp.where(gvalid, allov, 0)
+                mine = gvalid & (gsafe // self.rows_per_shard == my)
+                lidx = jnp.where(mine, gsafe - my * self.rows_per_shard, 0)
+                part = gather_rows(lidx.reshape(-1)).reshape(F, L_ov, -1)
+                part = jnp.where(mine[:, :, None], part, 0)
+                return jax.lax.psum(part, self.axis)[my]
+
+            def _no_overflow(ov_ids):
+                return jnp.zeros((L_ov, dim), local_table.dtype)
+
+            # the predicate is a feature-group psum — uniform across every
+            # participant of the branch collectives, so this cannot
+            # deadlock; a clean batch skips the fallback comm entirely
+            ov_rows = jax.lax.cond(overflow > 0, _fallback, _no_overflow,
+                                   ov_ids)
+            ov_rank = jnp.cumsum(ov_mask.astype(jnp.int32)) - 1
+            rows_sorted = jnp.where(
+                ov_mask[:, None],
+                ov_rows[jnp.clip(ov_rank, 0, L_ov - 1)],
+                main_rows,
+            )
+        rows = rows_sorted[inverse_permutation_gather(order)]
+        return jnp.where(valid[:, None], rows, 0), overflow
+
+    def _gather_fn(self, padded_len: int, dtype, routed: bool = False,
+                   cap: int | None = None):
         """Memoized jitted shard_map gather (a fresh wrapper per call would
         re-trace on every eager batch).
 
@@ -179,17 +388,29 @@ class ShardedTensor(KernelChoice):
         by psum. ``routed=True``: ids shard over EVERY mesh axis and each
         device routes its own slice to the owning shards (routed_gather),
         so per-device gather work is 1/num_devices of the request instead
-        of 1/data_size.
+        of 1/data_size; ``cap`` selects the capped-bucket comm mode and
+        the routed program returns ``(rows, overflow_count)`` with the
+        count psum'd over the whole mesh (replicated).
         """
-        cache_key = (padded_len, np.dtype(dtype).name, routed)
+        cache_key = (padded_len, np.dtype(dtype).name, routed, cap)
         if cache_key in self._gather_cache:
             return self._gather_cache[cache_key]
 
         if routed:
             ids_axes = tuple(self.mesh.axis_names)
+            other_axes = tuple(
+                a for a in self.mesh.axis_names if a != self.axis
+            )
 
             def body(local_table, local_ids):
-                return self.routed_gather(local_table, local_ids)
+                rows, ov = self.routed_gather(
+                    local_table, local_ids, cap=cap, with_overflow=True
+                )
+                if other_axes:  # feature-psum'd already; replicate mesh-wide
+                    ov = jax.lax.psum(ov, other_axes)
+                return rows, ov
+
+            out_specs = (P(ids_axes, None), P())
         else:
             ids_axes = tuple(
                 a for a in self.mesh.axis_names if a != self.axis
@@ -199,12 +420,15 @@ class ShardedTensor(KernelChoice):
                 part = self.local_gather(local_table, local_ids)
                 return jax.lax.psum(part, self.axis)
 
+            out_specs = P(ids_axes, None)
+
         f = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(P(self.axis, None), P(ids_axes)),
-                out_specs=P(ids_axes, None),
+                out_specs=out_specs,
+                check_vma=False,
             )
         )
         self._gather_cache[cache_key] = f
@@ -216,16 +440,28 @@ class ShardedTensor(KernelChoice):
         if self.table is not None:
             self.table.delete()
         self.table = None
+        self.last_routed_overflow = None
         self._gather_cache.clear()
 
-    def gather(self, ids, routed: bool = False):
+    def gather(self, ids, routed: bool = False, routed_cap="auto"):
         """Standalone sharded gather.
 
         ``routed=False``: ids shard over the data axes (replicated across
         the feature axis); remote rows arrive by psum. ``routed=True``: ids
         shard over EVERY axis and each device routes its slice to the
         owning shards (two all_to_alls) — per-device work drops by the
-        feature-axis width; see routed_gather. Same results either way.
+        feature-axis width; see routed_gather. Same results either way
+        (bit-identical).
+
+        ``routed_cap`` picks the routed comm mode (see routed_gather's comm
+        model): ``"auto"`` (default) caps destination buckets at
+        ``ceil(routed_alpha * L / F)`` lanes — ``alpha*L`` moved per hop
+        instead of ``F*L`` — and auto-grows ``routed_alpha`` on the next
+        call after a batch overflows (the overflowed lanes themselves are
+        fallback-served, so results stay exact). ``None`` forces the
+        uncapped full-length buckets; an int is an explicit per-bucket
+        capacity. After a routed call ``last_routed_overflow`` holds the
+        batch's fallback-served lane count (device scalar).
         """
         mult = 1
         for a in self.mesh.axis_names:
@@ -235,12 +471,34 @@ class ShardedTensor(KernelChoice):
         pad = (-n) % mult
         if pad:
             # -1 = the documented invalid-lane sentinel. Padded lanes are
-            # still routed and gathered (routed_gather remaps them to row-0
-            # requests), but their results are zeroed — correct output, not
-            # skipped work. (psum-path local_gather treats any non-owned id
-            # as zeros, so -1 is safe there too.)
+            # zeroed in the output — correct output, not skipped work.
+            # (psum-path local_gather treats any non-owned id as zeros and
+            # the routed paths never fetch them, so -1 is safe everywhere.)
             ids = jnp.concatenate([ids, jnp.full(pad, -1, ids.dtype)])
-        out = self._gather_fn(ids.shape[0], ids.dtype, routed)(self.table, ids)
+        if not routed:
+            out = self._gather_fn(ids.shape[0], ids.dtype, False)(
+                self.table, ids
+            )
+            return out[:n] if pad else out
+        local_len = ids.shape[0] // mult
+        if routed_cap == "auto":
+            self._maybe_grow_routed_alpha()
+            cap = self.routed_cap(local_len)
+        elif routed_cap is None:
+            cap = None
+        else:
+            cap = min(int(routed_cap), local_len)
+        if cap is not None and cap >= local_len:
+            cap = None  # full-length buckets: share the uncapped program
+        out, ov = self._gather_fn(ids.shape[0], ids.dtype, True, cap)(
+            self.table, ids
+        )
+        if not isinstance(ov, jax.core.Tracer):
+            # eager call: stash the device scalar for the auto-tuner /
+            # benchmarks. Under an outer jit trace ov is a tracer — storing
+            # it would leak; in-program callers use routed_gather's
+            # with_overflow return instead.
+            self.last_routed_overflow = ov
         return out[:n] if pad else out
 
     def __getitem__(self, ids):
@@ -266,10 +524,14 @@ class ShardedFeature(KernelChoice):
         hot_shuffle_seed: int = 0,
         kernel: str = "auto",
         dtype=None,
+        routed_alpha: float = 2.0,
     ):
         self.mesh = mesh
         self.axis = axis
         self._kernel = validate_gather_kernel(kernel)
+        if routed_alpha <= 0:
+            raise ValueError(f"routed_alpha must be > 0, got {routed_alpha}")
+        self.routed_alpha = float(routed_alpha)
         self.storage_dtype = _parse_storage_dtype(dtype)
         self.cache_policy = CachePolicy.MESH_SHARD
         self.cache_budget = parse_size_bytes(device_cache_size)
@@ -326,7 +588,8 @@ class ShardedFeature(KernelChoice):
         self.hot_rows = int(hot_rows)
         if hot_rows > 0:
             self.hot = ShardedTensor(
-                self.mesh, self.axis, kernel=self._kernel
+                self.mesh, self.axis, kernel=self._kernel,
+                routed_alpha=self.routed_alpha,
             ).from_cpu_tensor(tensor[:hot_rows])
         if hot_rows < n:
             self.cold, self._cold_is_host = to_pinned_host(
@@ -364,13 +627,25 @@ class ShardedFeature(KernelChoice):
         """Gather rows for data-axis-sharded (or replicated) node ids."""
         return self.gather(n_id)
 
-    def gather(self, n_id, routed: bool = False):
+    @property
+    def last_routed_overflow(self):
+        """Fallback-served lane count of the hot tier's last capped routed
+        gather (device scalar; None before any routed call)."""
+        return None if self.hot is None else self.hot.last_routed_overflow
+
+    def gather(self, n_id, routed: bool = False, routed_cap="auto"):
         """Tiered gather; ``routed=True`` uses the owner-routed hot-tier
         flavor (ids sharded over every mesh axis — see
-        ShardedTensor.gather) instead of the psum flavor."""
+        ShardedTensor.gather) instead of the psum flavor. ``routed_cap``
+        selects the routed comm mode ("auto" = capped buckets at
+        ``ceil(routed_alpha*L/F)`` with auto-grow on overflow, None =
+        uncapped full-length buckets, int = explicit capacity); overflow
+        is fallback-served and counted in ``last_routed_overflow``."""
         hot_gather = (
             None if self.hot is None
-            else lambda ids: self.hot.gather(ids, routed=routed)
+            else lambda ids: self.hot.gather(
+                ids, routed=routed, routed_cap=routed_cap
+            )
         )
         cold_gather = (
             None

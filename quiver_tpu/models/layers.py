@@ -22,6 +22,8 @@ Two aggregation paths, identical results:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -34,6 +36,35 @@ __all__ = [
     "zero_scatter_counts",
     "occurrence_counts",
 ]
+
+
+def _check_enabled() -> bool:
+    """QUIVER_CHECK=1 turns on the debug-mode layout assertions."""
+    return os.environ.get("QUIVER_CHECK", "0") not in (
+        "", "0", "false", "False"
+    )
+
+
+def _raise_layout_violation(count):
+    if int(count) > 0:
+        raise AssertionError(
+            f"QUIVER_CHECK: {int(count)} valid edge lanes violate the "
+            "regular layout dst == repeat(arange(num_dst), fanout) that "
+            "the dense aggregation path trusts; this Adj's fanout claim "
+            "is wrong and the dense path would mis-aggregate"
+        )
+
+
+def _check_regular_layout(dst, valid, num_dst: int, fanout: int) -> None:
+    """Debug-mode assertion of the regular-layout claim the dense-path
+    gate trusts (ADVICE layers.py:93): lane ``s*fanout + k`` targets seed
+    ``s`` on every valid lane. jit-composable via debug.callback; only
+    traced when QUIVER_CHECK is set, so the default path pays nothing."""
+    expected = jnp.repeat(
+        jnp.arange(num_dst, dtype=dst.dtype), fanout
+    )
+    bad = jnp.sum(((dst != expected) & valid).astype(jnp.int32))
+    jax.debug.callback(_raise_layout_violation, bad)
 
 
 def gather_src(x, src):
@@ -91,9 +122,24 @@ def segment_mean_aggregate(messages, dst, valid, num_dst: int,
     padded-shape analogue of skipping masked edges.
     """
     if fanout is not None and messages.shape[0] == num_dst * fanout:
+        if _check_enabled():
+            _check_regular_layout(dst, valid, num_dst, fanout)
         total = fanout_sum_aggregate(messages, valid, num_dst, fanout)
         cnt = valid.reshape(num_dst, fanout).sum(1).astype(messages.dtype)
         return total / jnp.maximum(cnt, 1.0)[:, None]
+    if fanout is not None:
+        from ..utils.trace import info_once
+
+        # the gate failed on SHAPE: fanout promised the dense layout but
+        # E != num_dst*fanout, so this aggregation silently reverts to the
+        # segment-scatter path (XLA serializes scatters on TPU) — make the
+        # perf regression visible (ADVICE layers.py:93)
+        info_once(
+            f"dense-gate-fallback-{messages.shape[0]}-{num_dst}-{fanout}",
+            "Adj.fanout=%d set but E=%d != num_dst*fanout=%d; falling back "
+            "to the segment-scatter aggregation path (slow on TPU)",
+            fanout, messages.shape[0], num_dst * fanout,
+        )
     seg = jnp.where(valid, dst, num_dst)
     total = jax.ops.segment_sum(messages, seg, num_segments=num_dst + 1)[:num_dst]
     cnt = jax.ops.segment_sum(valid.astype(messages.dtype), seg, num_segments=num_dst + 1)[:num_dst]

@@ -43,11 +43,26 @@ def resolve_dedup(dedup: str) -> str:
       ~1.8 ms/M elements (r3 link characterization); provisional until
       the ``sampler-hbm --dedup both`` self-selection lands on hardware.
 
-    ``QUIVER_DEDUP=sort|map|scan`` overrides (chip-window forcing).
+    ``QUIVER_DEDUP=sort|map|scan`` overrides the ``"auto"`` resolution
+    ONLY (chip-window forcing): call sites passing an explicit strategy
+    keep it — benchmark variant labels must match what actually ran — and
+    the first such ignored force is logged so the mismatch is visible.
     Unknown names raise — a typo must not silently fall back to a
     strategy (the callers' dispatch treats anything non-map/scan as sort).
     """
     if dedup in DEDUP_STRATEGIES:
+        import os
+
+        forced = os.environ.get("QUIVER_DEDUP", "").strip()
+        if forced and forced != dedup:
+            from ..utils.trace import info_once
+
+            info_once(
+                f"dedup-env-ignored-{dedup}",
+                "QUIVER_DEDUP=%s ignored for explicit dedup=%r (the env "
+                "override applies only to dedup='auto')",
+                forced, dedup,
+            )
         return dedup
     if dedup != "auto":
         raise ValueError(

@@ -15,7 +15,7 @@ cd "$(dirname "$0")/.."
 JOBS=("$@")
 if [ ${#JOBS[@]} -eq 0 ]; then
   JOBS=(feature-replicate feature-replicate-xla feature-bf16 feature-int8
-        feature-shard-routed)
+        feature-shard-routed feature-shard-routed-capped)
 fi
 JAX_PLATFORMS=cpu \
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -72,6 +72,7 @@ ORDER = [
     ("infer-layerwise", 900),
     ("saint-node", 900),
     ("feature-shard-routed", 900),
+    ("feature-shard-routed-capped", 900),
     ("acceptance", 1800),
     ("sweep", 2400),
 ]

@@ -18,6 +18,11 @@ import sys
 
 import pytest
 
+# multihost: minutes of multi-process rendezvous, and the jax CPU backend
+# must support multiprocess collectives — out of the tier-1
+# `-m 'not slow'` budget (VERDICT r5 weak #5)
+pytestmark = pytest.mark.slow
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "distributed_worker.py")
 
