@@ -55,6 +55,15 @@ def main():
         "(per-row absmax quantization, dequant on gather) quarters them",
     )
     p.add_argument(
+        "--replicate-budget", default="0", metavar="BYTES",
+        help="per-chip byte budget for the L0 replicated super-hot tier "
+        "(same parser as device_cache_size, e.g. '16M'): the top-degree "
+        "rows are replicated in every chip's HBM and served with ZERO "
+        "interconnect lanes; the sharded tier only carries the remaining "
+        "(1-h0) of the traffic, and the routed cap is tightened by the "
+        "measured L0 hit rate. 0 = the two-tier (PR 1) path",
+    )
+    p.add_argument(
         "--stream", type=int, default=0, metavar="N",
         help="headline via a fused id stream: lax.scan over N pre-staged "
         "device id batches in ONE compiled program (ids come from the "
@@ -83,7 +92,7 @@ def _body(args):
     if args.policy == "replicate":
         store = Feature(
             device_cache_size=budget, csr_topo=topo, kernel=args.kernel,
-            dtype=dtype,
+            dtype=dtype, replicate_budget=args.replicate_budget,
         ).from_cpu_tensor(feat)
     else:
         mesh = make_mesh(feature=len(jax.devices()))
@@ -94,6 +103,7 @@ def _body(args):
             kernel=args.kernel,
             dtype=dtype,
             routed_alpha=args.routed_alpha or 2.0,
+            replicate_budget=args.replicate_budget,
         ).from_cpu_tensor(feat)
     del feat
 
@@ -124,6 +134,19 @@ def _body(args):
         res = fetch(jnp.asarray(batches[i % len(batches)]))
     jax.block_until_ready(res)
     log(f"warmup+compile: {time.time()-t0:.1f}s; hot ratio {store.cache_ratio:.2f}")
+
+    # three-tier: the warmup measured the L0 hit rate; L0 lanes enter the
+    # routed gather as invalid and occupy no bucket capacity, so the cap
+    # can be tightened by (1-h0) — the sharded tier physically moves
+    # ~alpha*L*(1-h0) lanes per hop instead of alpha*L. Re-plan, then pay
+    # the one retrace outside the clock.
+    h0 = _tier_hit_rates(store).get("hit_rep", 0.0)
+    if h0 > 0 and routed_cap is not None:
+        routed_cap, routed_model = _routed_comm_model(args, store, h0=h0)
+        log(f"L0 hit rate {h0:.3f}: routed cap tightened to {routed_cap} "
+            f"({routed_model['lanes_per_hop']} lanes/hop)")
+        res = fetch(jnp.asarray(batches[0]))
+        jax.block_until_ready(res)
 
     # count bytes PHYSICALLY moved by the gather: the stored dtype's row
     # bytes (+ the 4-byte dequant scale per row for int8) — int8's output
@@ -164,11 +187,12 @@ def _body(args):
         gather_batch=args.gather_batch,
         dispatch="percall",
         routed=getattr(args, "routed", False),
+        **_tier_hit_rates(store),
         **_routed_extras(store, routed_model),
     )
 
 
-def _routed_comm_model(args, store):
+def _routed_comm_model(args, store, h0: float = 0.0):
     """Per-device comm-volume model of the routed hot-tier gather.
 
     Lanes (feature-row slots) each all_to_all hop carries per device:
@@ -177,6 +201,12 @@ def _routed_comm_model(args, store):
     L is the per-device request length after padding. The model is exact —
     bucket shapes are static — and the measured overflow count (fallback-
     served lanes) rides alongside it in the record.
+
+    ``h0`` is the measured L0 (replicated-tier) hit rate: L0 lanes enter
+    the routed gather as invalid and occupy no bucket capacity, so the cap
+    shrinks to ``ceil(alpha * (1-h0) * L / F)`` and the effective per-hop
+    volume to ``~alpha * L * (1-h0)`` — strictly below the two-tier capped
+    path whenever the super-hot tier is catching traffic.
 
     Returns (explicit_cap_or_None, model_extras_dict_or_None).
     """
@@ -195,13 +225,39 @@ def _routed_comm_model(args, store):
             "lanes_per_hop_uncapped": uncapped_lanes,
             "comm_reduction": 1.0,
         }
-    cap = store.hot.routed_cap(local_len, args.routed_alpha)
-    return cap, {
+    h0 = min(max(float(h0), 0.0), 1.0)
+    alpha_eff = max(args.routed_alpha * (1.0 - h0), 1e-6)
+    cap = store.hot.routed_cap(local_len, alpha_eff)
+    extras = {
         "routed_alpha": args.routed_alpha,
         "routed_cap": cap,
         "lanes_per_hop": F * cap,
         "lanes_per_hop_uncapped": uncapped_lanes,
         "comm_reduction": round(uncapped_lanes / (F * cap), 2),
+    }
+    if h0 > 0:
+        extras["l0_hit_rate"] = round(h0, 4)
+        extras["effective_lanes_per_hop"] = round(
+            args.routed_alpha * local_len * (1.0 - h0), 1
+        )
+    return cap, extras
+
+
+def _tier_hit_rates(store):
+    """Measured per-tier hit rates of the store's last eager gather
+    (ShardedFeature telemetry; {} for stores without it or before any
+    eager batch)."""
+    hits = getattr(store, "last_tier_hits", None)
+    if hits is None:
+        return {}
+    h = np.asarray(hits).astype(np.float64)
+    tot = h.sum()
+    if tot <= 0:
+        return {}
+    return {
+        "hit_rep": round(h[0] / tot, 4),
+        "hit_sharded": round(h[1] / tot, 4),
+        "hit_cold": round(h[2] / tot, 4),
     }
 
 
@@ -281,6 +337,7 @@ def _stream_gbps(args, store, batches, stored_itemsize, row_overhead,
         stream_batches=args.stream,
         routed=getattr(args, "routed", False),
         **extras,
+        **_tier_hit_rates(store),
         **_routed_extras(store, routed_model),
     )
 

@@ -107,6 +107,13 @@ JOBS = [
      "capped-bucket routed gather: cap=ceil(2*L/F) per destination, "
      "~2*L lanes/hop vs the uncapped row's F*L (lanes_per_hop + measured "
      "overflow in the record; overflow lanes are fallback-served)"),
+    ("feature-threetier", "benchmarks.bench_feature",
+     ["--policy", "shard", "--routed", "--routed-alpha", "2",
+      "--replicate-budget", "16M", "--stream", "32"],
+     "three-tier store: top-degree rows replicated per chip (L0, zero "
+     "interconnect lanes) in front of the capped routed sharded tier; "
+     "per-tier hit rates + cap tightened by the measured L0 hit rate, "
+     "effective lanes/hop = 2*L*(1-h0) vs the capped row's 2*L"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))
@@ -315,7 +322,8 @@ def write_outputs(results, out, smoke, merge=False):
                                "layer", "stage", "dispatch", "stream_batches",
                                "dedup", "roofline_frac", "ceiling_gbps",
                                "topo_mode", "cache_ratio", "elected",
-                               "model", "prng")}
+                               "model", "prng", "hit_rep", "hit_cold",
+                               "effective_lanes_per_hop")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

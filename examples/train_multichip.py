@@ -66,6 +66,12 @@ def main():
                    "(seed-sharding=all): each all_to_all hop moves "
                    "~alpha*L lanes instead of F*L; overflow is "
                    "fallback-served and reported. 0 = uncapped")
+    p.add_argument("--replicate-budget", default="0", metavar="BYTES",
+                   help="per-chip byte budget ('4M', '0.5G') for the L0 "
+                   "replicated super-hot tier: the top-degree rows live "
+                   "in every chip's HBM and are gathered with zero "
+                   "interconnect lanes; per-tier hit counts are reported "
+                   "after training. 0 = two-tier store")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -81,7 +87,8 @@ def main():
     # fused trainer needs the table fully device-resident: budget = all rows,
     # sharded over the feature axis (the clique-partitioned hot cache)
     feature = ShardedFeature(
-        mesh, device_cache_size=n * args.feature_dim * 4, csr_topo=topo
+        mesh, device_cache_size=n * args.feature_dim * 4, csr_topo=topo,
+        replicate_budget=args.replicate_budget,
     ).from_cpu_tensor(feat)
     del feat
     labels = jnp.asarray(rng.integers(0, args.classes, n).astype(np.int32))
@@ -118,6 +125,13 @@ def main():
         print(f"routed overflow (last step): "
               f"{int(trainer.last_routed_overflow)} lanes fallback-served "
               f"(grow --routed-alpha if persistent)")
+    if trainer.last_tier_hits is not None:
+        h = np.asarray(trainer.last_tier_hits)
+        tot = max(int(h.sum()), 1)
+        print(f"feature tier hits (last step): L0 replicated {h[0]} "
+              f"({100 * h[0] / tot:.1f}%, zero-comm), sharded {h[1]} "
+              f"({100 * h[1] / tot:.1f}%), cold {h[2]} "
+              f"({100 * h[2] / tot:.1f}%)")
 
 
 if __name__ == "__main__":

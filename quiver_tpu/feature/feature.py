@@ -22,6 +22,11 @@ Cold-lane trick: every lookup gathers both tiers at full batch width (static
 shapes), but lanes belonging to the other tier are pointed at row 0, so the
 host-side cost collapses to the true cold-miss count's bandwidth (repeated
 row 0 stays in cache) rather than the batch width.
+
+``tiered_lookup`` is the shared tier-merge: up to three contiguous tiers in
+the translated row space (replicated super-hot / hot / cold — see
+feature/shard.py for the three-tier ShardedFeature) with optional
+in-program per-tier hit counting.
 """
 
 from __future__ import annotations
@@ -86,22 +91,31 @@ def _dequant_fn(gather, scale_for):
     return lambda ids: gather(ids).astype(jnp.float32) * scale_for(ids)[:, None]
 
 
-def wrap_dequant_gathers(scale, hot_rows: int, hot_gather, cold_gather):
-    """Shared int8-dequant wrapping for both feature stores' tiered gathers.
+def wrap_dequant_gathers(scale, hot_rows: int, hot_gather, cold_gather,
+                         rep_gather=None, rep_rows: int = 0):
+    """Shared int8-dequant wrapping for the feature stores' tiered gathers.
 
-    Scale ids live in the translated (reordered) global row space: hot
-    gathers receive those directly, cold gathers receive ids offset by
-    ``hot_rows``. No-op when ``scale`` is None (unquantized storage).
+    Scale ids live in the translated (reordered) global row space; each
+    tier's gather receives ids local to its own table, so the scale lookup
+    re-offsets them: replicated rows sit at [0, rep_rows), sharded-hot rows
+    at [rep_rows, rep_rows + hot_rows), cold rows above. No-op when
+    ``scale`` is None (unquantized storage).
+
+    Returns ``(rep_gather, hot_gather, cold_gather)``.
     """
     if scale is None:
-        return hot_gather, cold_gather
+        return rep_gather, hot_gather, cold_gather
+    if rep_gather is not None:
+        rep_gather = _dequant_fn(rep_gather, lambda ids: scale[ids])
     if hot_gather is not None:
-        hot_gather = _dequant_fn(hot_gather, lambda ids: scale[ids])
+        hot_gather = _dequant_fn(
+            hot_gather, lambda ids: scale[ids + rep_rows]
+        )
     if cold_gather is not None:
         cold_gather = _dequant_fn(
-            cold_gather, lambda ids: scale[ids + hot_rows]
+            cold_gather, lambda ids: scale[ids + rep_rows + hot_rows]
         )
-    return hot_gather, cold_gather
+    return rep_gather, hot_gather, cold_gather
 
 
 def validate_gather_kernel(kernel: str) -> str:
@@ -315,28 +329,68 @@ class KernelChoice:
         return resolved
 
 
-def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather):
-    """Shared hot/cold tier-merge used by Feature and ShardedFeature.
+def tiered_lookup(n_id, feature_order, hot_rows: int, hot_gather, cold_gather,
+                  rep_rows: int = 0, rep_gather=None, hot_miss_id: int = 0,
+                  with_hits: bool = False):
+    """Shared tier-merge used by Feature and ShardedFeature.
 
-    ``hot_gather``/``cold_gather`` are callables (ids) -> rows, either may be
-    None. Invalid lanes (-1) return zero rows; lanes belonging to the other
-    tier are pointed at row 0 so their bandwidth collapses to one cached row.
+    Three contiguous tiers in the translated (reordered) row space:
+
+    * replicated super-hot ``[0, rep_rows)`` — ``rep_gather`` (zero-comm
+      local gather, every device holds the full block);
+    * hot ``[rep_rows, rep_rows + hot_rows)`` — ``hot_gather`` (HBM; sharded
+      stores serve it with a psum or routed collective);
+    * cold ``[rep_rows + hot_rows, n)`` — ``cold_gather`` (host-staged).
+
+    Each gather is a callable (tier-local ids) -> rows; any may be None
+    (its boundary range is then empty or covered by a neighbor). Invalid
+    lanes (-1) return zero rows; lanes belonging to another tier are pointed
+    at row 0 so their bandwidth collapses to one cached row — except the
+    hot tier's, which carry ``hot_miss_id`` (pass -1 for the sharded
+    gathers: their documented invalid-lane sentinel keeps other-tier lanes
+    out of the routed buckets and the psum, so they cost zero collective
+    lanes instead of a redundant row-0 fetch).
+
+    ``with_hits=True`` additionally returns an int32 ``(3,)`` vector of
+    VALID lanes per tier boundary ``[replicated, hot, cold]`` — the local
+    per-tier hit counts (callers inside ``shard_map`` psum them).
     """
     n_id = jnp.asarray(n_id)
     valid = n_id >= 0
     ids = jnp.where(valid, n_id, 0)
     if feature_order is not None:
         ids = feature_order[ids]
-    if cold_gather is None:
-        out = hot_gather(ids)
-    elif hot_gather is None:
-        out = cold_gather(ids)
+    hot_end = rep_rows + hot_rows
+    have_rep = rep_gather is not None and rep_rows > 0
+    # (mask, gather, row offset into the tier's table, other-tier miss id);
+    # masks partition the valid id range, in tier order
+    tiers = []
+    if have_rep:
+        tiers.append((ids < rep_rows, rep_gather, 0, 0))
+    if hot_gather is not None:
+        m = ids < hot_end
+        if have_rep:
+            m = m & (ids >= rep_rows)
+        tiers.append((m, hot_gather, rep_rows, hot_miss_id))
+    if cold_gather is not None:
+        tiers.append((ids >= hot_end, cold_gather, hot_end, 0))
+    if len(tiers) == 1:
+        _, gather, off, _ = tiers[0]
+        out = gather(ids - off if off else ids)
     else:
-        is_hot = ids < hot_rows
-        hot_part = hot_gather(jnp.where(is_hot, ids, 0))
-        cold_part = cold_gather(jnp.where(is_hot, 0, ids - hot_rows))
-        out = jnp.where(is_hot[:, None], hot_part, cold_part)
-    return jnp.where(valid[:, None], out, 0)
+        out = None
+        for mask, gather, off, miss in tiers:
+            part = gather(jnp.where(mask, ids - off, miss))
+            out = part if out is None else jnp.where(mask[:, None], part, out)
+    out = jnp.where(valid[:, None], out, 0)
+    if not with_hits:
+        return out
+    hits = jnp.stack([
+        jnp.sum((valid & (ids < rep_rows)).astype(jnp.int32)),
+        jnp.sum((valid & (ids >= rep_rows) & (ids < hot_end)).astype(jnp.int32)),
+        jnp.sum((valid & (ids >= hot_end)).astype(jnp.int32)),
+    ])
+    return out, hits
 
 
 @jax.tree_util.register_pytree_node_class
@@ -351,6 +405,12 @@ class Feature(KernelChoice):
       device_cache_size: hot-tier byte budget ("0.9M", "3GB", int bytes).
       cache_policy: "device_replicate" | "p2p_clique_replicate"/"mesh_shard".
       csr_topo: enables degree-based hot ordering; sets csr_topo.feature_order.
+      replicate_budget: L0 super-hot byte budget (same parser). Under
+        device_replicate the whole hot tier is ALREADY a zero-comm
+        per-device replica, so the L0/L1 distinction collapses: the bytes
+        are folded into ``device_cache_size`` (one-shot INFO log). The
+        argument exists so policy configs port unchanged between Feature
+        and ShardedFeature, where L0 is a real third tier.
     """
 
     def __init__(
@@ -363,6 +423,7 @@ class Feature(KernelChoice):
         hot_shuffle_seed: int = 0,
         kernel: str = "auto",
         dtype=None,
+        replicate_budget: int | str = 0,
     ):
         self.rank = rank
         self.device_list = device_list or [0]
@@ -377,6 +438,19 @@ class Feature(KernelChoice):
                 rank, device_list, child="feature",
             )
         self.cache_budget = parse_size_bytes(device_cache_size)
+        self.replicate_budget = parse_size_bytes(replicate_budget)
+        if self.replicate_budget:
+            # device_replicate's hot tier is already replicated per device —
+            # there is no cheaper tier to promote rows into, so the L0
+            # budget simply buys more hot rows
+            info_once(
+                "feature-replicate-budget-folded",
+                "Feature(device_replicate) already replicates its hot tier "
+                "per device; replicate_budget=%d B folded into "
+                "device_cache_size (one zero-comm tier)",
+                self.replicate_budget, child="feature",
+            )
+            self.cache_budget += self.replicate_budget
         self.cache_policy = CachePolicy.parse(cache_policy)
         self.csr_topo = csr_topo
         self.hot_shuffle_seed = hot_shuffle_seed
@@ -478,7 +552,7 @@ class Feature(KernelChoice):
             if self.cold is None
             else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
         )
-        hot_gather, cold_gather = wrap_dequant_gathers(
+        _, hot_gather, cold_gather = wrap_dequant_gathers(
             self.scale, self.hot_rows, hot_gather, cold_gather
         )
         with trace_scope("feature_gather"):
@@ -509,6 +583,7 @@ class Feature(KernelChoice):
             self.hot_shuffle_seed,
             self._kernel,
             self.storage_dtype,
+            self.replicate_budget,
         )
         return children, aux
 
@@ -528,6 +603,7 @@ class Feature(KernelChoice):
             obj.hot_shuffle_seed,
             obj._kernel,
             obj.storage_dtype,
+            obj.replicate_budget,
         ) = aux
         obj.device_list = list(device_list)
         obj.csr_topo = None

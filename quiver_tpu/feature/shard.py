@@ -16,8 +16,11 @@ cross-clique Python fallback path (shard_tensor.py:166-208): devices that
 share no ICI would sit on different meshes entirely.
 
 ``ShardedTensor`` is the generic row-sharded 2-D table (reference
-ShardTensor parity); ``ShardedFeature`` layers feature_order translation and
-the cold host tier on top (reference Feature with p2p_clique_replicate).
+ShardTensor parity); ``ShardedFeature`` layers feature_order translation,
+an optional L0 *replicated super-hot tier* (``replicate_budget`` — the
+top-degree rows in every chip's HBM, gathered with zero interconnect
+lanes), and the cold host tier on top (reference Feature with
+device_replicate + p2p_clique_replicate + UVA, as one three-tier store).
 
 When every feature-group member requests its OWN id set (routed mode, the
 seed_sharding="all" trainer), requests are routed to their owning shard
@@ -54,7 +57,7 @@ from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
 from ..ops.reindex import inverse_permutation_gather
 from ..ops.sample import staged_gather
-from ..utils.trace import get_logger
+from ..utils.trace import get_logger, info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..utils.reorder import reorder_by_degree
 
@@ -507,12 +510,29 @@ class ShardedTensor(KernelChoice):
 
 
 class ShardedFeature(KernelChoice):
-    """Feature store with mesh-sharded hot tier + host cold tier.
+    """Feature store with a three-tier memory hierarchy over the mesh:
 
-    The MESH_SHARD realization of the reference's ``p2p_clique_replicate``
-    policy (feature.py:126-166). Budget is *per device*, matching the
-    reference's per-GPU ``device_cache_size``; total hot rows = budget x
-    feature-axis size.
+    * **L0 replicated super-hot** (``replicate_budget`` bytes/device): the
+      top-β rows by degree, a full copy in EVERY chip's HBM, served by a
+      pure local gather — zero interconnect lanes. The reference's
+      ``device_replicate`` policy, scoped to only the rows hot enough to
+      earn F× the HBM.
+    * **L1 mesh-sharded hot** (``device_cache_size`` bytes/device): the
+      MESH_SHARD realization of ``p2p_clique_replicate``
+      (feature.py:126-166) — rows sharded over the feature axis, gathers
+      ride ICI collectives (psum or owner-routed all_to_all).
+    * **cold**: pinned-host rows with staged host-compute gathers (the UVA
+      zero-copy role).
+
+    Both budgets are *per device*, matching the reference's per-GPU
+    ``device_cache_size``; total L1 rows = budget × feature-axis size,
+    while an L0 row costs its bytes on every device.
+
+    Per-tier hit counts of the last eager gather land in
+    ``last_tier_hits`` (int32 ``(3,)`` device vector,
+    ``[replicated, sharded, cold]``) — the measured hit distribution the
+    ``auto_split=True`` tuner uses to move the L0/L1 boundary between
+    batches (see :meth:`_maybe_auto_split`).
     """
 
     def __init__(
@@ -525,6 +545,8 @@ class ShardedFeature(KernelChoice):
         kernel: str = "auto",
         dtype=None,
         routed_alpha: float = 2.0,
+        replicate_budget: int | str = 0,
+        auto_split: bool = False,
     ):
         self.mesh = mesh
         self.axis = axis
@@ -535,15 +557,99 @@ class ShardedFeature(KernelChoice):
         self.storage_dtype = _parse_storage_dtype(dtype)
         self.cache_policy = CachePolicy.MESH_SHARD
         self.cache_budget = parse_size_bytes(device_cache_size)
+        self.replicate_budget = parse_size_bytes(replicate_budget)
+        self.auto_split = bool(auto_split)
         self.csr_topo = csr_topo
         self.hot_shuffle_seed = hot_shuffle_seed
+        self.rep = None  # L0: (rep_rows, F) mesh-replicated block
         self.hot: ShardedTensor | None = None
         self.cold = None
         self._cold_is_host = False
         self.feature_order = None
         self.scale = None  # (N,) dequant scales (int8 storage only)
+        self.rep_rows = 0
         self.hot_rows = 0
         self.shape = None
+        # per-tier hit counts [replicated, sharded, cold] of the last eager
+        # gather (device int32 (3,); None before any). Trainers overwrite it
+        # with their psum'd batch totals so the split tuner sees the fused
+        # path's traffic too.
+        self.last_tier_hits = None
+        # host copy of the device region (rows [0, rep_rows + hot_rows) in
+        # storage dtype) kept iff the L0/L1 boundary may move after
+        # placement (auto_split or a nonzero replicate budget) — resplit
+        # rebuilds both tiers from it without touching the cold tier
+        self._region_host = None
+        self._rep_ceiling_rows = 0  # auto_split never grows L0 past this
+
+    def _plan_split(self, n: int, f: int, itemsize: int, quantized: bool,
+                    num_shards: int) -> tuple[int, int]:
+        """(rep_rows, hot_rows) from the two per-device byte budgets."""
+        if quantized:
+            # the (N,) f32 scale array is replicated on EVERY device (all
+            # tiers dequantize on device) — charge its 4N bytes against the
+            # budgets before spending on 1-byte-element rows. Sharded budget
+            # pays first (the scale is its dequant state even cold-only);
+            # any shortfall eats into the replicate budget.
+            scale_bytes = 4 * n
+            combined = self.cache_budget + self.replicate_budget
+            if 0 < combined < scale_bytes:
+                # budget-edge: cannot even hold the dequant scales — degrade
+                # to cold-only (exact, host-served) instead of crashing or
+                # silently mis-splitting
+                info_once(
+                    "sharded-int8-budget-below-scale",
+                    "ShardedFeature(int8): combined cache budget %d B is "
+                    "smaller than the replicated dequant-scale array "
+                    "(4 B x %d rows = %d B); degrading to a cold-only "
+                    "store (exact, host-served). Grow device_cache_size "
+                    "past 4*n bytes to enable device tiers.",
+                    combined, n, scale_bytes, child="feature",
+                )
+                return 0, 0
+            c_budget = self.cache_budget - scale_bytes
+            r_budget = self.replicate_budget
+            if c_budget < 0:
+                r_budget = max(r_budget + c_budget, 0)
+                c_budget = 0
+            rep_rows = min(n, r_budget // f)
+            hot_rows = min(n - rep_rows, (c_budget // f) * num_shards)
+            return rep_rows, hot_rows
+        row_bytes = f * itemsize
+        rep_rows = min(n, self.replicate_budget // row_bytes)
+        hot_rows = min(
+            n - rep_rows, (self.cache_budget // row_bytes) * num_shards
+        )
+        return rep_rows, hot_rows
+
+    def _place_region(self, region: np.ndarray, rep_rows: int) -> None:
+        """(Re)build the L0 + L1 device tiers from the device-region rows.
+
+        ``region`` holds rows [0, rep_rows + hot_rows) of the translated
+        row space in storage dtype; the boundary at ``rep_rows`` decides
+        which prefix is replicated."""
+        old_rep, old_hot = self.rep, self.hot
+        total = region.shape[0]
+        rep_rows = max(0, min(int(rep_rows), total))
+        if rep_rows > 0:
+            self.rep = jax.device_put(
+                region[:rep_rows], NamedSharding(self.mesh, P())
+            )
+        else:
+            self.rep = None
+        if total - rep_rows > 0:
+            self.hot = ShardedTensor(
+                self.mesh, self.axis, kernel=self._kernel,
+                routed_alpha=self.routed_alpha,
+            ).from_cpu_tensor(region[rep_rows:])
+        else:
+            self.hot = None
+        self.rep_rows = rep_rows
+        self.hot_rows = total - rep_rows
+        if old_rep is not None and hasattr(old_rep, "delete"):
+            old_rep.delete()
+        if old_hot is not None:
+            old_hot.delete()
 
     def from_cpu_tensor(self, tensor: np.ndarray) -> "ShardedFeature":
         tensor = np.asarray(tensor)
@@ -559,22 +665,24 @@ class ShardedFeature(KernelChoice):
             tensor = tensor.astype(self.storage_dtype)
         n, f = tensor.shape
         num_shards = self.mesh.shape[self.axis]
-        if quantized:
-            # the (N,) f32 scale array is replicated on EVERY device (both
-            # tiers dequantize on device) — charge its 4N bytes against the
-            # per-device budget before spending on 1-byte-element hot rows
-            per_dev_rows = max(self.cache_budget - 4 * n, 0) // f
-            hot_rows = min(n, per_dev_rows * num_shards)
-        else:
-            row_bytes = f * tensor.dtype.itemsize
-            hot_rows = min(n, (self.cache_budget // row_bytes) * num_shards)
+        rep_rows, hot_rows = self._plan_split(
+            n, f, tensor.dtype.itemsize, quantized, num_shards
+        )
+        device_rows = rep_rows + hot_rows
 
-        if self.csr_topo is not None and 0 < hot_rows < n:
+        # degree order matters whenever a tier boundary cuts [0, n): the
+        # L0 prefix wants the literal top-degree rows (pinned, unshuffled —
+        # replication needs no shard balance), the sharded span keeps the
+        # balance shuffle
+        if self.csr_topo is not None and 0 < device_rows and (
+            device_rows < n or 0 < rep_rows < n
+        ):
             tensor, order = reorder_by_degree(
                 tensor,
                 self.csr_topo.degree,
-                hot_rows / n,
+                device_rows / n,
                 seed=self.hot_shuffle_seed,
+                pin_top=rep_rows,
             )
             self.csr_topo.feature_order = order
             self.feature_order = jnp.asarray(order)
@@ -585,43 +693,138 @@ class ShardedFeature(KernelChoice):
 
         self.shape = (n, f)
         self.dtype = tensor.dtype
-        self.hot_rows = int(hot_rows)
-        if hot_rows > 0:
-            self.hot = ShardedTensor(
-                self.mesh, self.axis, kernel=self._kernel,
-                routed_alpha=self.routed_alpha,
-            ).from_cpu_tensor(tensor[:hot_rows])
-        if hot_rows < n:
+        self._rep_ceiling_rows = rep_rows
+        if device_rows > 0:
+            region = tensor[:device_rows]
+            if self.auto_split or self.replicate_budget > 0:
+                self._region_host = np.ascontiguousarray(region)
+            self._place_region(region, rep_rows)
+        if device_rows < n:
             self.cold, self._cold_is_host = to_pinned_host(
-                tensor[hot_rows:], mesh=self.mesh
+                tensor[device_rows:], mesh=self.mesh
             )
         # placement report (reference shard_tensor.py:153-162 LOG>>> parity)
         get_logger("feature").info(
-            "%.2f%% of feature (%d/%d rows) sharded over %d devices on "
-            "mesh axis '%s' (%.1f MB/device); cold tier: %s",
-            100.0 * hot_rows / max(n, 1),
-            hot_rows,
+            "feature tiers: %d/%d rows replicated (L0, %.1f MB/device), "
+            "%d sharded over %d devices on mesh axis '%s' (%.1f MB/device); "
+            "cold tier: %s",
+            rep_rows,
             n,
+            rep_rows * f * tensor.dtype.itemsize / 2**20,
+            hot_rows,
             num_shards,
             self.axis,
             hot_rows * f * tensor.dtype.itemsize / num_shards / 2**20,
-            "pinned host" if self._cold_is_host else ("none" if hot_rows == n else "device"),
+            "pinned host" if self._cold_is_host
+            else ("none" if device_rows == n else "device"),
         )
         return self
 
     @property
     def cache_ratio(self) -> float:
-        return self.hot_rows / self.shape[0] if self.shape else 0.0
+        """Fraction of rows resident in device HBM (both L0 and L1)."""
+        if not self.shape:
+            return 0.0
+        return (self.rep_rows + self.hot_rows) / self.shape[0]
+
+    @property
+    def replicated_ratio(self) -> float:
+        return self.rep_rows / self.shape[0] if self.shape else 0.0
+
+    def resplit(self, rep_rows: int) -> None:
+        """Move the L0/L1 boundary to ``rep_rows`` (eager, between batches).
+
+        Tier membership in the translated row space is untouched — the
+        first ``rep_rows`` device rows become the replicated block, the
+        rest the sharded table — so gathers stay bit-identical; only the
+        comm path serving each row changes. Requires the retained host
+        region (``auto_split=True`` or ``replicate_budget > 0`` at
+        construction). Compiled consumers retrace on the new table shapes.
+        """
+        if self._region_host is None:
+            if max(0, int(rep_rows)) == self.rep_rows:
+                return  # no-op split (e.g. a trainer passing budget 0)
+            raise ValueError(
+                "resplit needs the retained host region: construct "
+                "ShardedFeature with replicate_budget > 0 or auto_split=True"
+            )
+        total = self._region_host.shape[0]
+        rep_rows = max(0, min(int(rep_rows), total))
+        if rep_rows == self.rep_rows:
+            return
+        self._place_region(self._region_host, rep_rows)
+        # stale hits describe the OLD boundary; the tuner must not act on
+        # them against the new one
+        self.last_tier_hits = None
+
+    def resplit_budget(self, replicate_budget: int | str) -> None:
+        """:meth:`resplit` with the boundary given in bytes/device (same
+        parser as ``device_cache_size``). Raises the L0 ceiling the
+        ``auto_split`` tuner honors."""
+        budget = parse_size_bytes(replicate_budget)
+        row_bytes = self.shape[1] * np.dtype(self.dtype).itemsize
+        rows = budget // max(row_bytes, 1)
+        self._rep_ceiling_rows = max(self._rep_ceiling_rows, rows)
+        self.resplit(rows)
+
+    def _maybe_auto_split(self) -> None:
+        """Move the L0/L1 boundary toward the measured hit distribution.
+
+        Consumes ``last_tier_hits`` (the previous eager batch — long
+        completed, so the read is cheap). With h0/h1 the replicated/sharded
+        hit counts and dev = h0 + h1:
+
+        * **grow** (double ``rep_rows``, up to the budget ceiling) when
+          ``h1 > h0`` but L0 is clearly in the traffic (``h0 >= dev/8``):
+          the hit mass sits just beyond the boundary — pull it into the
+          zero-comm tier.
+        * **shrink** (halve) when ``h0 < dev/8``: the replicated rows are
+          not earning their F× HBM cost; hand them back to the sharded
+          tier (same rows covered, 1/F the per-device bytes).
+
+        The dead band between the two rules prevents oscillation; each
+        move is a factor of 2, one per batch, INFO-logged.
+        """
+        hits = self.last_tier_hits
+        if hits is None or self._region_host is None:
+            return
+        self.last_tier_hits = None
+        try:
+            h0, h1, _hc = (int(v) for v in np.asarray(hits))
+        except Exception:  # noqa: BLE001 — a deleted/donated buffer must
+            return  # not break the next gather
+        dev = h0 + h1
+        if dev <= 0:
+            return
+        total = self._region_host.shape[0]
+        ceiling = min(self._rep_ceiling_rows, total)
+        new = None
+        why = ""
+        if h0 * 8 < dev and self.rep_rows > 0:
+            new, why = self.rep_rows // 2, "L0 under-hit"
+        elif h1 > h0 and 0 < self.rep_rows < ceiling:
+            new, why = min(self.rep_rows * 2, ceiling), "hit mass beyond L0"
+        if new is None or new == self.rep_rows:
+            return
+        get_logger("feature").info(
+            "auto-split: %s (L0 %d vs sharded %d hits); moving "
+            "replicated/sharded boundary %d -> %d rows",
+            why, h0, h1, self.rep_rows, new,
+        )
+        self.resplit(new)
 
     def delete(self) -> None:
-        """Free hot/cold buffers now (reference ``shard_tensor.delete``)."""
+        """Free all tier buffers now (reference ``shard_tensor.delete``)."""
         if self.hot is not None:
             self.hot.delete()
-        for buf in (self.cold, self.feature_order, self.scale):
+        for buf in (self.rep, self.cold, self.feature_order, self.scale):
             if buf is not None and hasattr(buf, "delete"):
                 buf.delete()
-        self.hot = self.cold = self.feature_order = self.scale = None
-        self.hot_rows = 0
+        self.rep = self.hot = self.cold = None
+        self.feature_order = self.scale = None
+        self.rep_rows = self.hot_rows = 0
+        self.last_tier_hits = None
+        self._region_host = None
 
     def __getitem__(self, n_id):
         """Gather rows for data-axis-sharded (or replicated) node ids."""
@@ -634,13 +837,28 @@ class ShardedFeature(KernelChoice):
         return None if self.hot is None else self.hot.last_routed_overflow
 
     def gather(self, n_id, routed: bool = False, routed_cap="auto"):
-        """Tiered gather; ``routed=True`` uses the owner-routed hot-tier
-        flavor (ids sharded over every mesh axis — see
-        ShardedTensor.gather) instead of the psum flavor. ``routed_cap``
-        selects the routed comm mode ("auto" = capped buckets at
-        ``ceil(routed_alpha*L/F)`` with auto-grow on overflow, None =
-        uncapped full-length buckets, int = explicit capacity); overflow
-        is fallback-served and counted in ``last_routed_overflow``."""
+        """Three-tier gather (replicated L0 / sharded L1 / host cold);
+        ``routed=True`` uses the owner-routed L1 flavor (ids sharded over
+        every mesh axis — see ShardedTensor.gather) instead of the psum
+        flavor. ``routed_cap`` selects the routed comm mode ("auto" =
+        capped buckets at ``ceil(routed_alpha*L/F)`` with auto-grow on
+        overflow, None = uncapped full-length buckets, int = explicit
+        capacity); overflow is fallback-served and counted in
+        ``last_routed_overflow``.
+
+        L0 and cold lanes enter the L1 gather as -1 (its invalid-lane
+        sentinel), so they occupy zero routed-bucket capacity and
+        contribute zero psum lanes — an L0 hit really does cost no
+        interconnect. After an eager call ``last_tier_hits`` holds the
+        batch's per-tier hit counts (int32 (3,)); with ``auto_split=True``
+        the measured distribution moves the L0/L1 boundary before the next
+        batch (:meth:`_maybe_auto_split`)."""
+        if self.auto_split:
+            self._maybe_auto_split()
+        rep_gather = (
+            None if self.rep is None
+            else _hot_gather_fn(self.rep, self.kernel)
+        )
         hot_gather = (
             None if self.hot is None
             else lambda ids: self.hot.gather(
@@ -652,12 +870,21 @@ class ShardedFeature(KernelChoice):
             if self.cold is None
             else lambda ids: staged_gather(self.cold, ids, self._cold_is_host)
         )
-        # int8 tiers dequantize after the (psum'd or routed) gather; only
-        # one shard contributes non-zero int8 rows so the reduction is
-        # overflow-free
-        hot_gather, cold_gather = wrap_dequant_gathers(
-            self.scale, self.hot_rows, hot_gather, cold_gather
+        # int8 tiers dequantize after the (local, psum'd, or routed)
+        # gather; only one shard contributes non-zero int8 rows so the
+        # reduction is overflow-free
+        rep_gather, hot_gather, cold_gather = wrap_dequant_gathers(
+            self.scale, self.hot_rows, hot_gather, cold_gather,
+            rep_gather, self.rep_rows,
         )
-        return tiered_lookup(
-            n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather
+        out, hits = tiered_lookup(
+            n_id, self.feature_order, self.hot_rows, hot_gather, cold_gather,
+            rep_rows=self.rep_rows, rep_gather=rep_gather, hot_miss_id=-1,
+            with_hits=True,
         )
+        if not isinstance(hits, jax.core.Tracer):
+            # eager call: stash for the split tuner / benchmarks (an outer
+            # jit's tracer must not leak; in-program callers use
+            # tiered_lookup's with_hits return directly)
+            self.last_tier_hits = hits
+        return out

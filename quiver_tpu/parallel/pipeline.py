@@ -72,21 +72,27 @@ class Prefetcher:
     def run(self, seed_stream: Iterable) -> Iterator[Batch]:
         """Yield Batches for each seed array in ``seed_stream``, keeping up
         to ``depth`` in flight. Exceptions from the worker surface at the
-        yield for the offending batch, in order."""
-        with concurrent.futures.ThreadPoolExecutor(
+        yield for the offending batch, in order.
+
+        A consumer that stops early (``break`` / ``gen.close()``) returns
+        promptly: queued dispatches are cancelled and the pool is shut down
+        WITHOUT joining the worker — an executor ``with``-block's exit
+        would park the consumer behind the in-flight sample+gather, work
+        nobody will read. The worker thread finishes that one dispatch in
+        the background and exits on its own."""
+        pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="quiver-prefetch"
-        ) as pool:
-            inflight: collections.deque = collections.deque()
-            it = iter(seed_stream)
-            try:
-                for seeds in it:
-                    inflight.append(pool.submit(self._dispatch, seeds))
-                    if len(inflight) > self.depth:
-                        yield inflight.popleft().result()
-                while inflight:
+        )
+        inflight: collections.deque = collections.deque()
+        it = iter(seed_stream)
+        try:
+            for seeds in it:
+                inflight.append(pool.submit(self._dispatch, seeds))
+                if len(inflight) > self.depth:
                     yield inflight.popleft().result()
-            finally:
-                for f in inflight:  # consumer bailed early: drop queued work
-                    f.cancel()
+            while inflight:
+                yield inflight.popleft().result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     __call__ = run

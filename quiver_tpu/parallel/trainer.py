@@ -36,8 +36,10 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.config import parse_size_bytes
 from ..feature.feature import Feature
 from ..feature.shard import ShardedFeature
+from ..utils.trace import info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..parallel.pipeline import Prefetcher
 from ..parallel.train import cross_entropy_on_seeds
@@ -70,6 +72,7 @@ class DistributedTrainer:
         local_batch: int = 128,
         seed_sharding: str = "data",
         routed_alpha: float | None = 2.0,
+        replicate_budget: int | str | None = None,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -111,6 +114,29 @@ class DistributedTrainer:
         # (or per-step vector of the last epoch_scan); 0 when the gather
         # is psum-flavored or uncapped
         self.last_routed_overflow = None
+        # per-tier hit counts [replicated, sharded, cold] of the last
+        # step's feature gather, psum'd mesh-wide (int32 (3,) device
+        # vector; (steps, 3) after epoch_scan) — the measured hit
+        # distribution the eager split tuner consumes between batches
+        self.last_tier_hits = None
+        # replicate_budget: L0 super-hot tier override. A value re-splits a
+        # ShardedFeature's replicated/sharded boundary BEFORE the program
+        # is built (needs the store's retained host region); on a plain
+        # Feature the hot tier is already a per-device replica, so the
+        # argument is accepted-and-INERT (one-shot log). None = keep the
+        # store's own split.
+        if replicate_budget is not None:
+            if isinstance(feature, ShardedFeature):
+                feature.resplit_budget(replicate_budget)
+            elif parse_size_bytes(replicate_budget):
+                info_once(
+                    "trainer-replicate-budget-inert",
+                    "DistributedTrainer(replicate_budget=%r) on a "
+                    "device_replicate Feature is INERT: its hot tier is "
+                    "already replicated per device (zero-comm); size it "
+                    "with device_cache_size",
+                    replicate_budget,
+                )
         if self.seed_sharding == "data" and mesh.shape[FEATURE_AXIS] > 1:
             from ..utils.trace import get_logger
 
@@ -174,13 +200,18 @@ class DistributedTrainer:
 
     def _feature_parts(self):
         """The feature-store arrays handed to the shard_map program:
-        (hot, cold, feature_order, scale)."""
-        hot = (
-            self.feature.hot.table
-            if isinstance(self.feature, ShardedFeature)
-            else self.feature.hot
-        )
-        return (hot, self._cold, self.feature.feature_order,
+        (rep, hot, cold, feature_order, scale). ``rep`` is the L0
+        replicated super-hot block (ShardedFeature only; None on a plain
+        Feature, whose whole hot tier is already a per-device replica).
+        Read fresh each step: an eager resplit between batches swaps the
+        tier buffers, and the new shapes re-key the jit cache."""
+        if isinstance(self.feature, ShardedFeature):
+            rep = self.feature.rep
+            hot = None if self.feature.hot is None else self.feature.hot.table
+        else:
+            rep = None
+            hot = self.feature.hot
+        return (rep, hot, self._cold, self.feature.feature_order,
                 self.feature.scale)
 
     def _build(self):
@@ -193,26 +224,37 @@ class DistributedTrainer:
         sizes = sampler.sizes
         sharded = isinstance(feature, ShardedFeature)
         cold_is_host = getattr(feature, "_cold_is_host", False)
-        hot_rows = feature.hot_rows
 
         routed = self.seed_sharding == "all"
         routed_alpha = self.routed_alpha
 
         def gather_features(parts, n_id):
-            """Tiered gather; returns (rows, routed_overflow_count) — the
-            count is the feature-group total of capped-bucket fallback
-            lanes (0 for psum/uncapped/unsharded gathers)."""
+            """Three-tier gather; returns (rows, routed_overflow_count,
+            tier_hits) — the count is the feature-group total of
+            capped-bucket fallback lanes (0 for psum/uncapped/unsharded
+            gathers), tier_hits the local int32 (3,) per-tier hit vector
+            (the step body psums it mesh-wide)."""
             from ..feature.feature import tiered_lookup, wrap_dequant_gathers
             from ..ops.sample import staged_gather
 
-            hot_table, cold_table, order, scale = parts
+            rep_table, hot_table, cold_table, order, scale = parts
+            # tier boundaries read at TRACE time, not capture time: an
+            # eager resplit between batches moves them, and the changed
+            # table shapes force this retrace
+            rep_rows = feature.rep_rows if sharded else 0
+            hot_rows = feature.hot_rows
             ov_box = [jnp.zeros((), jnp.int32)]
+            rep_g = (
+                None if rep_table is None
+                else lambda ids: rep_table[ids]
+            )
             if hot_table is None:
                 hot_g = None
             elif sharded and routed:
                 # distinct ids per feature-group member: route to owners.
                 # Bucket capacity is static per id-length (the tiered
-                # lookup calls with the full n_id width).
+                # lookup calls with the full n_id width). L0/cold lanes
+                # arrive as -1 and occupy no bucket capacity.
                 def hot_g(ids):
                     cap = (
                         None if routed_alpha is None
@@ -235,9 +277,15 @@ class DistributedTrainer:
                 None if cold_table is None
                 else lambda ids: staged_gather(cold_table, ids, cold_is_host)
             )
-            hot_g, cold_g = wrap_dequant_gathers(scale, hot_rows, hot_g, cold_g)
-            x = tiered_lookup(n_id, order, hot_rows, hot_g, cold_g)
-            return x, ov_box[0]
+            rep_g, hot_g, cold_g = wrap_dequant_gathers(
+                scale, hot_rows, hot_g, cold_g, rep_g, rep_rows
+            )
+            x, hits = tiered_lookup(
+                n_id, order, hot_rows, hot_g, cold_g,
+                rep_rows=rep_rows, rep_gather=rep_g,
+                hot_miss_id=-1 if sharded else 0, with_hits=True,
+            )
+            return x, ov_box[0], hits
 
         def body(params, opt_state, topo, parts, seeds, labels, key):
             # distinct key per seed-block worker; under "data" sharding the
@@ -256,7 +304,7 @@ class DistributedTrainer:
                 weighted=sampler.weighted, kernel=sampler.kernel,
                 dedup=sampler.dedup,
             )
-            x, routed_ov = gather_features(parts, n_id)
+            x, routed_ov, tier_hits = gather_features(parts, n_id)
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
             mask = jnp.arange(seeds.shape[0]) < num_seeds
 
@@ -273,17 +321,24 @@ class DistributedTrainer:
             # feature-psum'd already inside routed_gather; the data-axis
             # psum makes the batch total replicated mesh-wide
             routed_ov = jax.lax.psum(routed_ov, DATA_AXIS)
+            # tier hits: under "all" every device holds distinct lanes, so
+            # the mesh-wide psum is the batch total; under "data" the
+            # feature-group members process the SAME lanes redundantly —
+            # summing them too would overcount each lane F times
+            tier_hits = jax.lax.psum(
+                tier_hits, axes if routed else DATA_AXIS
+            )
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, routed_ov
+            return params, opt_state, loss, routed_ov, tier_hits
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
-        parts_spec = (hot_spec, P(), P(), P())
+        parts_spec = (P(), hot_spec, P(), P(), P())
         fn = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), P(), P(), parts_spec, self._seed_spec(), P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -336,19 +391,34 @@ class DistributedTrainer:
 
         Batch metadata: after the call ``last_routed_overflow`` holds the
         step's capped-bucket fallback lane count (device scalar; 0 unless
-        seed_sharding="all" with a sharded feature and a cap). Persistent
-        overflow means ``routed_alpha`` is too small for the id skew —
-        grow it (a new trainer or ``routed_alpha=None``) between epochs.
+        seed_sharding="all" with a sharded feature and a cap) and
+        ``last_tier_hits`` the mesh-total per-tier feature-hit vector
+        (int32 (3,), [replicated, sharded, cold]). Persistent overflow
+        means ``routed_alpha`` is too small for the id skew — grow it (a
+        new trainer or ``routed_alpha=None``) between epochs.
+
+        A ShardedFeature built with ``auto_split=True`` consumes the hit
+        vector here: the eager tuner moves its replicated/sharded boundary
+        before the next step's dispatch (the changed tier shapes re-key
+        the jit cache, so the program retraces on the new split).
         """
+        feature = self.feature
+        if isinstance(feature, ShardedFeature) and feature.auto_split:
+            feature._maybe_auto_split()
         packed = self.shard_seeds(seeds)
         packed = jax.device_put(
             jnp.asarray(packed), NamedSharding(self.mesh, self._seed_spec())
         )
-        params, opt_state, loss, routed_ov = self._step(
+        params, opt_state, loss, routed_ov, tier_hits = self._step(
             params, opt_state, self.topo, self._feature_parts(), packed,
             labels, key
         )
         self.last_routed_overflow = routed_ov
+        self.last_tier_hits = tier_hits
+        if isinstance(feature, ShardedFeature):
+            # hand the batch totals to the store so its eager split tuner
+            # sees the fused path's traffic too
+            feature.last_tier_hits = tier_hits
         return params, opt_state, loss
 
     def pack_epoch(self, train_idx: np.ndarray, seed=None, key=None):
@@ -385,13 +455,15 @@ class DistributedTrainer:
             def body(carry, xs):
                 p, o = carry
                 seeds, k = xs
-                p, o, loss, routed_ov = step(p, o, topo, parts, seeds, labels, k)
-                return (p, o), (loss, routed_ov)
+                p, o, loss, routed_ov, hits = step(
+                    p, o, topo, parts, seeds, labels, k
+                )
+                return (p, o), (loss, routed_ov, hits)
 
-            (p, o), (losses, routed_ovs) = jax.lax.scan(
+            (p, o), (losses, routed_ovs, hits) = jax.lax.scan(
                 body, (params, opt_state), (seed_mat, keys)
             )
-            return p, o, losses, routed_ovs
+            return p, o, losses, routed_ovs, hits
 
         return fn  # jit's shape-keyed cache handles distinct step counts
 
@@ -407,18 +479,23 @@ class DistributedTrainer:
 
         Returns (params, opt_state, losses[steps]); the per-step
         capped-bucket fallback counts land in ``last_routed_overflow``
-        (an int32[steps] device array — batch metadata for the auto-tuner
-        and scoreboard).
+        (an int32[steps] device array) and the per-step per-tier feature
+        hits in ``last_tier_hits`` (int32[steps, 3],
+        [replicated, sharded, cold] mesh totals) — batch metadata for the
+        auto-tuners and scoreboard. The split is frozen for the scanned
+        epoch (one compiled program); the eager tuner moves it between
+        epochs.
         """
         packed = jax.device_put(
             jnp.asarray(seed_mat),
             NamedSharding(self.mesh, P(None, *self._seed_spec())),
         )
-        params, opt_state, losses, routed_ovs = self._epoch_fn(
+        params, opt_state, losses, routed_ovs, tier_hits = self._epoch_fn(
             params, opt_state, self.topo, self._feature_parts(), packed,
             labels, key
         )
         self.last_routed_overflow = routed_ovs
+        self.last_tier_hits = tier_hits
         return params, opt_state, losses
 
 

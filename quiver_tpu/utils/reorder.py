@@ -22,6 +22,7 @@ def reorder_by_degree(
     degree: np.ndarray,
     hot_ratio: float,
     seed: int = 0,
+    pin_top: int = 0,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Reorder feature rows hot-first by degree.
 
@@ -31,6 +32,11 @@ def reorder_by_degree(
       hot_ratio: fraction of rows that will live in the hot tier; this prefix
         of the degree-sorted order is randomly shuffled for shard balance.
       seed: shuffle seed.
+      pin_top: keep the top ``pin_top`` rows in strict descending-degree
+        order (excluded from the balance shuffle). The replicated super-hot
+        tier wants the literal top-β rows — every device holds a full copy,
+        so shard balance is meaningless there and shuffling would dilute it
+        with merely-warm rows.
 
     Returns:
       (new_feature, new_order) where new_order maps old node id -> new row,
@@ -43,9 +49,10 @@ def reorder_by_degree(
     # argsort of -degree: stable so equal-degree nodes keep id order
     perm = np.argsort(-degree.astype(np.int64), kind="stable")
     hot = int(n * hot_ratio)
-    if hot > 1:
+    pin = int(np.clip(pin_top, 0, hot))
+    if hot - pin > 1:
         rng = np.random.default_rng(seed)
-        rng.shuffle(perm[:hot])
+        rng.shuffle(perm[pin:hot])
     new_feature = feature[perm]
     new_order = np.empty(n, dtype=np.int64)
     new_order[perm] = np.arange(n, dtype=np.int64)

@@ -73,6 +73,7 @@ ORDER = [
     ("saint-node", 900),
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),
+    ("feature-threetier", 900),
     ("acceptance", 1800),
     ("sweep", 2400),
 ]

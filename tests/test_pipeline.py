@@ -1,6 +1,9 @@
 """Prefetcher tests: determinism vs the sequential loop, depth semantics,
 error propagation, early exit."""
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -105,3 +108,31 @@ def test_early_exit_cancels_cleanly(setup):
     )
     next(gen)
     gen.close()  # no hang, no exception
+
+
+def test_early_exit_returns_promptly_despite_inflight_dispatch(setup):
+    """A consumer ``break`` must not wait for the in-flight sample+gather:
+    the worker blocks on an event the test only releases AFTER close()
+    returns — with executor-``with`` semantics (shutdown(wait=True)) this
+    would deadlock until the 20s deadman fires."""
+    topo, _ = setup
+    inner = GraphSageSampler(topo, [3], seed_capacity=16, seed=0)
+    release = threading.Event()
+    calls = []
+
+    class SlowSampler:
+        def sample(self, seeds):
+            calls.append(1)
+            if len(calls) > 1:  # first batch fast, second blocks
+                release.wait(20)
+            return inner.sample(seeds)
+
+    gen = Prefetcher(SlowSampler(), None, depth=2).run(
+        _seed_stream(6, 16, topo.node_count)
+    )
+    next(gen)  # batch 1 delivered; batch 2 now blocked in flight
+    t0 = time.perf_counter()
+    gen.close()
+    dt = time.perf_counter() - t0
+    release.set()  # let the background worker finish and exit
+    assert dt < 5.0, f"early exit blocked {dt:.1f}s on the in-flight batch"
