@@ -77,6 +77,30 @@ def main():
         "it falls short of baseline",
     )
     p.add_argument(
+        "--topo-sharding",
+        default="replicated",
+        choices=["replicated", "mesh"],
+        dest="topo_sharding",
+        help="topology placement: 'replicated' (every chip holds the full "
+        "CSR — the reference's per-GPU device-resident registration) or "
+        "'mesh' — the CSR partitioned across the mesh's feature axis "
+        "(~1/F topology bytes per chip); each hop routes frontier "
+        "vertices to their owning shard over capped-bucket all_to_all "
+        "collectives (sampling/dist.py) and the record carries the exact "
+        "lanes-per-hop comm model + the measured fallback overflow",
+    )
+    p.add_argument(
+        "--routed-alpha",
+        type=float,
+        default=2.0,
+        metavar="A",
+        dest="routed_alpha",
+        help="--topo-sharding mesh: capped-bucket factor — per-destination "
+        "bucket capacity ceil(A*L/F) per hop, so each all_to_all moves "
+        "~A*L lanes instead of F*L; 0 = uncapped full-length buckets. "
+        "Overflow lanes are fallback-served (exact) and counted",
+    )
+    p.add_argument(
         "--stream",
         type=int,
         default=0,
@@ -283,10 +307,142 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
     )
 
 
+def _sharded_comm_model(sampler, seed_cap: int, caps) -> dict:
+    """Exact per-device lanes-per-hop model of the mesh-sharded sampler.
+
+    Hop ``l`` (seeds outward) routes a per-worker frontier of width
+    ``S_l = (seed_cap, caps[0], ..., caps[-2])[l]`` through four
+    ``all_to_all`` exchanges — ids out, degrees back, offsets out,
+    ``(cap, k)`` neighbor blocks back — moving
+    ``F * cap_l * (2 + 2 * k_l)`` lanes with capped buckets
+    (``cap_l = ceil(alpha * S_l / F)``) vs ``F * S_l * (2 + 2 * k_l)``
+    uncapped. Bucket shapes are static, so the model is exact; the
+    measured fallback overflow rides alongside it in the record.
+    """
+    from quiver_tpu.sampling.dist import routed_sample_cap
+
+    F = sampler.topo.num_shards
+    alpha = sampler.routed_alpha
+    widths = (seed_cap,) + tuple(caps[:-1])
+    lanes, lanes_unc, hop_caps = [], [], []
+    for S_l, k in zip(widths, sampler.sizes):
+        cap_l = routed_sample_cap(S_l, F, alpha) or S_l
+        hop_caps.append(int(cap_l))
+        lanes.append(F * cap_l * (2 + 2 * k))
+        lanes_unc.append(F * S_l * (2 + 2 * k))
+    model = {
+        "topo_sharding": "mesh",
+        "routed_alpha": alpha,
+        "hop_caps": hop_caps,
+        "lanes_per_hop": lanes,
+        "lanes_per_hop_uncapped": lanes_unc,
+        "comm_reduction": round(sum(lanes_unc) / max(sum(lanes), 1), 2),
+    }
+    plan = sampler.topo.plan
+    model.update(
+        topo_bytes_per_chip=plan["per_chip_bytes"],
+        topo_bytes_replicated=plan["replicated_bytes"],
+        topo_shrink=round(plan["shrink_factor"], 2),
+    )
+    return model
+
+
+def _body_sharded(args):
+    """--topo-sharding mesh lane: the distributed sampler over the CSR
+    partitioned across the mesh's feature axis. SEPS methodology is
+    unchanged (valid sampled edges / synchronized wall, per chip); the
+    record adds the exact lanes-per-hop comm model and the measured
+    per-hop fallback overflow (``last_sample_overflow``)."""
+    import jax
+
+    from quiver_tpu import GraphSageSampler
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    if args.weighted:
+        raise SystemExit("--topo-sharding mesh does not support --weighted "
+                         "(sharded CSR slices carry no weights)")
+    if args.kernel != "xla":
+        raise SystemExit("--topo-sharding mesh supports --kernel xla only")
+    if args.mode not in ("HBM", "GPU"):
+        raise SystemExit("--topo-sharding mesh requires --mode HBM (each "
+                         "shard's slice is device-resident — that is the "
+                         "point)")
+    if args.stream:
+        log("WARNING: --stream is not supported with --topo-sharding mesh; "
+            "measuring the per-call dispatch loop only")
+    dedup = "sort" if args.dedup == "both" else args.dedup
+    if args.dedup == "both":
+        log("WARNING: --dedup both is a stream-mode comparison; "
+            "--topo-sharding mesh measures dedup=sort only")
+
+    topo = build_graph(args)
+    F = len(jax.devices())
+    mesh = make_mesh(data=1, feature=F)
+    alpha = args.routed_alpha or None
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode="HBM", seed=args.seed, dedup=dedup,
+        topo_sharding="mesh", mesh=mesh, routed_alpha=alpha,
+        frontier_caps="auto" if args.caps == "auto" else None,
+    )
+    W = sampler.workers
+    rng = np.random.default_rng(args.seed)
+
+    t0 = time.time()
+    for _ in range(args.warmup):
+        out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
+        jax.block_until_ready(out.n_id)
+    log(f"warmup+compile: {time.time()-t0:.1f}s")
+
+    total_edges = 0
+    t0 = time.time()
+    for _ in range(args.iters):
+        out = sampler.sample(rng.integers(0, topo.node_count, args.batch))
+        total_edges += int(sum(out.edge_counts))
+    jax.block_until_ready(out.n_id)
+    dt = time.time() - t0
+    seps_chip = total_edges / dt / W
+
+    per_worker = -(-args.batch // W)
+    seed_cap = sampler._seed_capacity or max(
+        _bench_round_up(per_worker, 128), 128
+    )
+    caps = sampler._caps_for(seed_cap)
+    model = _sharded_comm_model(sampler, seed_cap, caps)
+    ov = sampler.last_sample_overflow
+    sample_overflow = (
+        [int(v) for v in np.asarray(ov)] if ov is not None
+        else [0] * len(sampler.sizes)
+    )
+    emit(
+        "sampled-edges/sec/chip",
+        seps_chip,
+        "SEPS",
+        BASELINE_UVA_SEPS,
+        mode="HBM",
+        kernel=args.kernel,
+        fanout=args.fanout,
+        batch=args.batch,
+        caps=args.caps,
+        dedup=dedup,
+        dispatch="percall",
+        mesh_devices=W,
+        seps_mesh_total=round(total_edges / dt),
+        sample_overflow=sample_overflow,
+        **model,
+    )
+
+
+def _bench_round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
 def _body(args):
     import jax
 
     from quiver_tpu import GraphSageSampler
+
+    if getattr(args, "topo_sharding", "replicated") == "mesh":
+        return _body_sharded(args)
 
     topo = build_graph(args)
     if args.weighted:

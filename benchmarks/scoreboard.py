@@ -114,6 +114,13 @@ JOBS = [
      "interconnect lanes) in front of the capped routed sharded tier; "
      "per-tier hit rates + cap tightened by the measured L0 hit rate, "
      "effective lanes/hop = 2*L*(1-h0) vs the capped row's 2*L"),
+    ("sampler-sharded", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--topo-sharding", "mesh", "--routed-alpha", "2"],
+     "mesh-sharded topology: CSR partitioned over the feature axis "
+     "(~1/F topology bytes/chip, topo_shrink in the record), per-hop "
+     "frontier routing over capped-bucket all_to_all — lanes-per-hop "
+     "model + measured sample_overflow; bit-identical to the replicated "
+     "sampler (tests/test_sharded_topology.py)"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))
@@ -323,7 +330,8 @@ def write_outputs(results, out, smoke, merge=False):
                                "dedup", "roofline_frac", "ceiling_gbps",
                                "topo_mode", "cache_ratio", "elected",
                                "model", "prng", "hit_rep", "hit_cold",
-                               "effective_lanes_per_hop")}
+                               "effective_lanes_per_hop", "topo_sharding",
+                               "topo_shrink", "comm_reduction")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

@@ -14,6 +14,7 @@ Top-level exports mirror the reference package surface
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
 from .datasets import GraphDataset, load_dataset, planted_partition
 from .core.hetero import HeteroCSRTopo, RelCSR
+from .core.sharded_topology import ShardedTopology
 from .core.topology import CSRTopo, DeviceTopology
 from .feature.feature import Feature, HeteroFeature
 from .feature.shard import ShardedFeature, ShardedTensor
@@ -27,6 +28,7 @@ from .sampling.saint import (
     SAINTRandomWalkSampler,
     saint_subgraph,
 )
+from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.debug import show_tensor_info, tensor_info
 from .utils.reorder import reorder_by_degree
@@ -39,6 +41,8 @@ p2pCliqueTopo = MeshTopo
 __all__ = [
     "CSRTopo",
     "DeviceTopology",
+    "ShardedTopology",
+    "DistGraphSageSampler",
     "HeteroCSRTopo",
     "RelCSR",
     "GraphSageSampler",

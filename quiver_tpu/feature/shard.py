@@ -55,8 +55,8 @@ from .feature import (
 )
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
-from ..ops.reindex import inverse_permutation_gather
 from ..ops.sample import staged_gather
+from ..parallel.routing import BucketRoute
 from ..utils.trace import get_logger, info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..utils.reorder import reorder_by_degree
@@ -223,164 +223,31 @@ class ShardedTensor(KernelChoice):
         valid = ids >= 0
         safe = jnp.where(valid, ids, 0)
 
-        if cap is None:
-            rows = self._routed_uncapped(local_table, safe, valid)
-            if with_overflow:
-                return rows, jnp.zeros((), jnp.int32)
-            return rows
-        rows, overflow = self._routed_capped(local_table, safe, valid, cap)
-        if with_overflow:
-            return rows, overflow
-        return rows
-
-    def _routed_uncapped(self, local_table, safe, valid):
-        """Exact-safe full-length buckets: F x L lanes per hop."""
-        F = self.num_shards
-        L = safe.shape[0]
-        owner = jnp.clip(safe // self.rows_per_shard, 0, F - 1)
-
-        # stable bucket order: sort my requests by owning shard
-        order = jnp.argsort(owner, stable=True)
-        sorted_ids = safe[order]
-        sorted_owner = owner[order]
-        # position of each sorted lane within its bucket
-        start = jnp.searchsorted(sorted_owner, jnp.arange(F, dtype=owner.dtype))
-        slot = jnp.arange(L, dtype=jnp.int32) - start[sorted_owner]
-        # send buckets (F, L): bucket f holds my requests owned by shard f
-        # (a contiguous run of the sorted view), empty lanes carry -1.
-        # Built by GATHER, not scatter — XLA serializes general scatters on
-        # TPU, and this sits on the per-batch routed hot path.
-        ends = jnp.concatenate(
-            [start[1:], jnp.full((1,), L, start.dtype)]
-        )
-        j = jnp.arange(L, dtype=jnp.int32)[None, :]
-        pos = jnp.clip(start[:, None] + j, 0, L - 1)
-        send = jnp.where(
-            j < (ends - start)[:, None], sorted_ids[pos], -1
-        )
-
-        # hop 1: bucket f goes to shard f; recv[g] = shard g's requests to me
-        recv = jax.lax.all_to_all(
-            send, self.axis, split_axis=0, concat_axis=0, tiled=False
-        ).reshape(F, L)
+        # one audited code path for both comm modes and both consumers
+        # (feature gather here, neighbor sampling in sampling/dist.py):
+        # parallel.routing.BucketRoute owns the sort-by-owner bucketing,
+        # the two all_to_all hops, and the cond-gated psum fallback
         my = jax.lax.axis_index(self.axis)
-        rvalid = recv >= 0
-        local_idx = jnp.where(rvalid, recv - my * self.rows_per_shard, 0)
-        served = _hot_gather_fn(local_table, self.kernel)(
-            local_idx.reshape(-1)
-        ).reshape(F, L, -1)
-        served = jnp.where(rvalid[:, :, None], served, 0)
-
-        # hop 2: answers return to their requesters
-        back = jax.lax.all_to_all(
-            served, self.axis, split_axis=0, concat_axis=0, tiled=False
-        ).reshape(F, L, -1)
-        # back[f, slot] = row for my sorted request (bucket f, position slot)
-        rows_sorted = back[sorted_owner, slot]
-        # undo the owner sort with a gather through the inverse permutation
-        # (argsort of int lanes) instead of scattering L x F_dim rows
-        rows = rows_sorted[inverse_permutation_gather(order)]
-        return jnp.where(valid[:, None], rows, 0)
-
-    def _routed_capped(self, local_table, safe, valid, cap: int):
-        """Capped buckets (F x cap lanes per hop) + gated psum fallback.
-
-        Returns (rows, overflow_count) — see :meth:`routed_gather` for the
-        comm model and the <= L-cap overflow-budget argument.
-        """
-        F = self.num_shards
-        L = safe.shape[0]
-        my = jax.lax.axis_index(self.axis)
+        rps = self.rows_per_shard
         gather_rows = _hot_gather_fn(local_table, self.kernel)
 
-        # invalid lanes go to a sentinel bucket F past the real ones: they
-        # are never routed at all (the uncapped path routes them as row-0
-        # requests — harmless there, but here they would eat bucket
-        # capacity and fake overflow)
-        owner = jnp.where(
-            valid, jnp.clip(safe // self.rows_per_shard, 0, F - 1), F
+        def serve(req_ids):
+            # ownership-masked local gather: zero for dead (-1) lanes and
+            # for ids another shard owns — required by the psum fallback,
+            # harmless on the main hop (routing guarantees ownership there)
+            mine = (req_ids >= 0) & (req_ids // rps == my)
+            lidx = jnp.where(mine, req_ids - my * rps, 0)
+            rows = gather_rows(lidx)
+            return jnp.where(mine[:, None], rows, 0)
+
+        route = BucketRoute(
+            safe, valid, safe // rps, axis=self.axis,
+            num_shards=self.num_shards, cap=cap,
         )
-        order = jnp.argsort(owner, stable=True)
-        sorted_ids = safe[order]
-        sorted_owner = owner[order]
-        sorted_valid = valid[order]
-        bounds = jnp.searchsorted(
-            sorted_owner, jnp.arange(F + 1, dtype=sorted_owner.dtype)
-        )
-        start, ends = bounds[:F], bounds[1:]
-        counts = ends - start
-        owner_c = jnp.clip(sorted_owner, 0, F - 1)
-        slot = jnp.arange(L, dtype=jnp.int32) - start[owner_c]
-
-        # send buckets (F, cap): the first cap requests per destination
-        j = jnp.arange(cap, dtype=jnp.int32)[None, :]
-        pos = jnp.clip(start[:, None] + j, 0, L - 1)
-        send = jnp.where(
-            j < jnp.minimum(counts, cap)[:, None], sorted_ids[pos], -1
-        )
-
-        # hop 1 + serve + hop 2, exactly as uncapped but cap-wide
-        recv = jax.lax.all_to_all(
-            send, self.axis, split_axis=0, concat_axis=0, tiled=False
-        ).reshape(F, cap)
-        rvalid = recv >= 0
-        local_idx = jnp.where(rvalid, recv - my * self.rows_per_shard, 0)
-        served = gather_rows(local_idx.reshape(-1)).reshape(F, cap, -1)
-        served = jnp.where(rvalid[:, :, None], served, 0)
-        back = jax.lax.all_to_all(
-            served, self.axis, split_axis=0, concat_axis=0, tiled=False
-        ).reshape(F, cap, -1)
-        main_rows = back[owner_c, jnp.clip(slot, 0, cap - 1)]
-
-        # overflowed lanes: valid requests past their bucket's capacity
-        ov_mask = sorted_valid & (slot >= cap)
-        ov_local = jnp.sum(ov_mask.astype(jnp.int32))
-        overflow = jax.lax.psum(ov_local, self.axis)
-        L_ov = L - cap  # exact-safe budget (proof in routed_gather's doc)
-        if L_ov == 0:
-            rows_sorted = main_rows
-        else:
-            dim = local_table.shape[1]
-            # compact my overflow ids to the static budget, overflow lanes
-            # first in sorted order (False < True, stable)
-            take = jnp.argsort(~ov_mask, stable=True)[:L_ov]
-            ov_ids = jnp.where(
-                jnp.arange(L_ov, dtype=jnp.int32) < ov_local,
-                sorted_ids[take], -1,
-            )
-
-            def _fallback(ov_ids):
-                # psum local_gather over the feature group: everyone sees
-                # everyone's overflow ids (cheap — int lanes, no rows),
-                # each shard contributes the rows it owns, the psum hands
-                # every member the full answer and it keeps its own slice
-                allov = jax.lax.all_gather(
-                    ov_ids, self.axis, tiled=False
-                ).reshape(F, L_ov)
-                gvalid = allov >= 0
-                gsafe = jnp.where(gvalid, allov, 0)
-                mine = gvalid & (gsafe // self.rows_per_shard == my)
-                lidx = jnp.where(mine, gsafe - my * self.rows_per_shard, 0)
-                part = gather_rows(lidx.reshape(-1)).reshape(F, L_ov, -1)
-                part = jnp.where(mine[:, :, None], part, 0)
-                return jax.lax.psum(part, self.axis)[my]
-
-            def _no_overflow(ov_ids):
-                return jnp.zeros((L_ov, dim), local_table.dtype)
-
-            # the predicate is a feature-group psum — uniform across every
-            # participant of the branch collectives, so this cannot
-            # deadlock; a clean batch skips the fallback comm entirely
-            ov_rows = jax.lax.cond(overflow > 0, _fallback, _no_overflow,
-                                   ov_ids)
-            ov_rank = jnp.cumsum(ov_mask.astype(jnp.int32)) - 1
-            rows_sorted = jnp.where(
-                ov_mask[:, None],
-                ov_rows[jnp.clip(ov_rank, 0, L_ov - 1)],
-                main_rows,
-            )
-        rows = rows_sorted[inverse_permutation_gather(order)]
-        return jnp.where(valid[:, None], rows, 0), overflow
+        rows = route.exchange(serve)
+        if with_overflow:
+            return rows, route.overflow
+        return rows
 
     def _gather_fn(self, padded_len: int, dtype, routed: bool = False,
                    cap: int | None = None):

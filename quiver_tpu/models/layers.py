@@ -35,7 +35,34 @@ __all__ = [
     "gather_src",
     "zero_scatter_counts",
     "occurrence_counts",
+    "resolve_counts_strategy",
 ]
+
+
+_counts_strategy: str | None = None
+
+
+def resolve_counts_strategy() -> str:
+    """The ``QUIVER_COUNTS`` histogram strategy, resolved ONCE per process.
+
+    Resolution (env override, else platform default — see
+    ``core.config.resolve_platform_strategy``) used to happen at trace time
+    inside jitted model code, which implied an env var read on every
+    retrace and made it look like ``QUIVER_COUNTS`` could flip a live
+    model. It cannot: jit caches keep whatever strategy they were traced
+    with. The first call — op construction / first model trace — pins the
+    strategy for the process; set ``QUIVER_COUNTS`` BEFORE constructing or
+    tracing any model that counts (chip-window forcing must precede the
+    first trace)."""
+    global _counts_strategy
+    if _counts_strategy is None:
+        from ..core.config import resolve_platform_strategy
+
+        _counts_strategy = resolve_platform_strategy(
+            "QUIVER_COUNTS", ("scan", "scatter"), tpu_default="scan",
+            other_default="scatter",
+        )
+    return _counts_strategy
 
 
 def _check_enabled() -> bool:
@@ -89,13 +116,10 @@ def occurrence_counts(ids, valid, n: int, dtype=jnp.float32):
     """Histogram of ``ids[valid]`` over [0, n), strategy picked per
     platform (the counts-shaped sibling of ops.reindex.resolve_dedup):
     zero-scatter sort+searchsorted on TPU, one scalar scatter-add
-    elsewhere. ``QUIVER_COUNTS=scan|scatter`` overrides."""
-    from ..core.config import resolve_platform_strategy
-
-    how = resolve_platform_strategy(
-        "QUIVER_COUNTS", ("scan", "scatter"), tpu_default="scan",
-        other_default="scatter",
-    )
+    elsewhere. ``QUIVER_COUNTS=scan|scatter`` overrides — resolved once
+    per process at op construction (:func:`resolve_counts_strategy`), so
+    the env force must be set before the first model trace."""
+    how = resolve_counts_strategy()
     if how == "scan":
         return zero_scatter_counts(ids, valid, n, dtype)
     return jax.ops.segment_sum(

@@ -53,7 +53,12 @@ class DistributedTrainer:
 
     Args:
       mesh: (data, feature) mesh from parallel.mesh.make_mesh.
-      sampler: GraphSageSampler (its topology is replicated to all devices).
+      sampler: GraphSageSampler (its topology is replicated to all devices)
+        or a ``topo_sharding="mesh"`` DistGraphSageSampler (the CSR itself
+        partitioned over the feature axis — requires
+        ``seed_sharding="all"``; per-hop neighbor lookups and the sharded
+        feature gather then share ONE ``routed_alpha`` budget and, with
+        ``auto_alpha=True``, one tuner).
       feature: Feature (device_replicate) or ShardedFeature (mesh_shard).
         Cold tiers are fused too: pinned-host rows ride as mesh-replicated
         operands and their staged gathers compose into the step program.
@@ -73,6 +78,7 @@ class DistributedTrainer:
         seed_sharding: str = "data",
         routed_alpha: float | None = 2.0,
         replicate_budget: int | str | None = None,
+        auto_alpha: bool = False,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -110,10 +116,22 @@ class DistributedTrainer:
                 f"routed_alpha must be > 0 or None, got {routed_alpha}"
             )
         self.routed_alpha = None if routed_alpha is None else float(routed_alpha)
+        # one routing budget for the whole step: the SAME routed_alpha caps
+        # the sharded-feature gather buckets AND (for a topo_sharding="mesh"
+        # sampler) the per-hop neighbor-routing buckets. auto_alpha=True
+        # turns on the shared tuner: after an eager batch whose feature OR
+        # sampler routing overflowed (fallback-served — exact, just extra
+        # comm), alpha doubles (capped at F) and the step retraces.
+        self.auto_alpha = bool(auto_alpha)
         # device scalar(s): fallback-served lane count of the last step
         # (or per-step vector of the last epoch_scan); 0 when the gather
         # is psum-flavored or uncapped
         self.last_routed_overflow = None
+        # sampling sibling: per-hop fallback-served lane counts of the
+        # topo-sharded sampler's last step (int32 (num_layers,) device
+        # vector, seeds-outward; (steps, num_layers) after epoch_scan;
+        # all-zero for replicated-topology samplers)
+        self.last_sample_overflow = None
         # per-tier hit counts [replicated, sharded, cold] of the last
         # step's feature gather, psum'd mesh-wide (int32 (3,) device
         # vector; (steps, 3) after epoch_scan) — the measured hit
@@ -153,7 +171,35 @@ class DistributedTrainer:
         self.model = model
         self.tx = tx
         self.local_batch = int(local_batch)
-        self.topo = self._mesh_wide_topo(sampler.topo)
+        # topo_sharding="mesh" sampler: the graph is partitioned over the
+        # feature axis — the step routes frontier vertices to their owning
+        # shard per hop (sampling/dist.py), so it REQUIRES every device to
+        # be a seed-block worker ("all"); under "data" the feature-group
+        # members would route the same frontier redundantly
+        self.topo_sharded = (
+            getattr(sampler, "topo_sharding", "replicated") == "mesh"
+        )
+        if self.topo_sharded:
+            if self.seed_sharding != "all":
+                raise ValueError(
+                    "a topo_sharding='mesh' sampler requires "
+                    "seed_sharding='all' (every device a full sampling "
+                    "worker over its own seed block)"
+                )
+            if sampler.mesh is not mesh:
+                raise ValueError(
+                    "the sampler's mesh must be the trainer's mesh "
+                    "(the topology partition and the step program must "
+                    "agree on the feature axis)"
+                )
+            if sampler.axis != FEATURE_AXIS:
+                raise ValueError(
+                    f"topo_sharding='mesh' sampler must shard over the "
+                    f"'{FEATURE_AXIS}' axis, got {sampler.axis!r}"
+                )
+            self.topo = (sampler.topo.indptr, sampler.topo.indices)
+        else:
+            self.topo = self._mesh_wide_topo(sampler.topo)
         self._cold = self._mesh_wide_host(feature.cold) if getattr(
             feature, "_cold_is_host", False) else feature.cold
         self.data_size = mesh.shape[DATA_AXIS]
@@ -227,6 +273,11 @@ class DistributedTrainer:
 
         routed = self.seed_sharding == "all"
         routed_alpha = self.routed_alpha
+        topo_sharded = self.topo_sharded
+        node_count = sampler.csr_topo.node_count
+        rows_per_shard = (
+            sampler.topo.rows_per_shard if topo_sharded else 0
+        )
 
         def gather_features(parts, n_id):
             """Three-tier gather; returns (rows, routed_overflow_count,
@@ -299,11 +350,28 @@ class DistributedTrainer:
             key = jax.random.fold_in(key, widx)
             sample_key, dropout_key = jax.random.split(key)
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
-            n_id, _, adjs, _, _, _ = multilayer_sample(
-                topo, seeds, num_seeds, sample_key, sizes, caps,
-                weighted=sampler.weighted, kernel=sampler.kernel,
-                dedup=sampler.dedup,
-            )
+            if topo_sharded:
+                # sharded-topology sampling: per-hop owner routing over the
+                # feature axis, SAME routing budget (routed_alpha) as the
+                # sharded feature gather below
+                from ..sampling.dist import dist_multilayer_sample
+
+                indptr_blk, indices_blk = topo
+                n_id, _, adjs, _, _, _, hop_ovs = dist_multilayer_sample(
+                    indptr_blk[0], indices_blk[0], rows_per_shard, seeds,
+                    num_seeds, sample_key, sizes, caps,
+                    axis=FEATURE_AXIS, num_shards=mesh.shape[FEATURE_AXIS],
+                    routed_alpha=routed_alpha, dedup=sampler.dedup,
+                    node_count=node_count,
+                )
+                sample_ov = jnp.stack(hop_ovs)  # feature-group totals
+            else:
+                n_id, _, adjs, _, _, _ = multilayer_sample(
+                    topo, seeds, num_seeds, sample_key, sizes, caps,
+                    weighted=sampler.weighted, kernel=sampler.kernel,
+                    dedup=sampler.dedup,
+                )
+                sample_ov = jnp.zeros((len(sizes),), jnp.int32)
             x, routed_ov, tier_hits = gather_features(parts, n_id)
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
             mask = jnp.arange(seeds.shape[0]) < num_seeds
@@ -328,17 +396,27 @@ class DistributedTrainer:
             tier_hits = jax.lax.psum(
                 tier_hits, axes if routed else DATA_AXIS
             )
+            if topo_sharded:
+                # per-hop sampling overflow: feature-psum'd inside the
+                # route; the data-axis psum makes it the mesh-wide total
+                sample_ov = jax.lax.psum(sample_ov, DATA_AXIS)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, routed_ov, tier_hits
+            return params, opt_state, loss, routed_ov, tier_hits, sample_ov
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
         parts_spec = (P(), hot_spec, P(), P(), P())
+        topo_spec = (
+            (P(FEATURE_AXIS, None), P(FEATURE_AXIS, None))
+            if topo_sharded else P()
+        )
         fn = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), parts_spec, self._seed_spec(), P(), P()),
-            out_specs=(P(), P(), P(), P(), P()),
+            in_specs=(
+                P(), P(), topo_spec, parts_spec, self._seed_spec(), P(), P(),
+            ),
+            out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -349,12 +427,26 @@ class DistributedTrainer:
         """Initialize params/opt_state from one locally-sampled batch."""
         n = self.sampler.csr_topo.node_count
         m = min(self.local_batch, n)
-        padded = np.full(self.local_batch, -1, np.int32)
-        padded[:m] = np.arange(m)
-        run, caps = self.sampler._compiled(self.local_batch)
-        _, _, adjs, _, _, _ = run(
-            self.sampler.topo, jnp.asarray(padded), jnp.int32(m), jax.random.PRNGKey(0)
-        )
+        if self.topo_sharded:
+            # no single-device program exists over a sharded topology, and
+            # model init only consumes Adj SHAPES/fanout — build empty
+            # (all-invalid) per-layer blocks with the planned caps
+            caps = self.caps
+            adjs = []
+            prev = self.local_batch
+            for cap, k in zip(caps, self.sampler.sizes):
+                ei = jnp.full((2, prev * k), -1, jnp.int32)
+                adjs.append(Adj(ei, None, (cap, prev), fanout=k))
+                prev = cap
+            adjs = adjs[::-1]
+        else:
+            padded = np.full(self.local_batch, -1, np.int32)
+            padded[:m] = np.arange(m)
+            run, caps = self.sampler._compiled(self.local_batch)
+            _, _, adjs, _, _, _ = run(
+                self.sampler.topo, jnp.asarray(padded), jnp.int32(m),
+                jax.random.PRNGKey(0)
+            )
         # the model sees what the tiered gather returns: dequantized f32 for
         # int8 storage, else the stored dtype (bf16/f32)
         dtype = (
@@ -391,11 +483,15 @@ class DistributedTrainer:
 
         Batch metadata: after the call ``last_routed_overflow`` holds the
         step's capped-bucket fallback lane count (device scalar; 0 unless
-        seed_sharding="all" with a sharded feature and a cap) and
+        seed_sharding="all" with a sharded feature and a cap),
         ``last_tier_hits`` the mesh-total per-tier feature-hit vector
-        (int32 (3,), [replicated, sharded, cold]). Persistent overflow
-        means ``routed_alpha`` is too small for the id skew — grow it (a
-        new trainer or ``routed_alpha=None``) between epochs.
+        (int32 (3,), [replicated, sharded, cold]), and
+        ``last_sample_overflow`` the topo-sharded sampler's per-hop
+        fallback lane counts (int32 (num_layers,), seeds-outward; zeros
+        for replicated topologies). Persistent overflow means
+        ``routed_alpha`` is too small for the id skew — pass
+        ``auto_alpha=True`` (the shared tuner grows it between batches)
+        or grow it yourself between epochs.
 
         A ShardedFeature built with ``auto_split=True`` consumes the hit
         vector here: the eager tuner moves its replicated/sharded boundary
@@ -405,16 +501,18 @@ class DistributedTrainer:
         feature = self.feature
         if isinstance(feature, ShardedFeature) and feature.auto_split:
             feature._maybe_auto_split()
+        self._maybe_grow_routed_alpha()
         packed = self.shard_seeds(seeds)
         packed = jax.device_put(
             jnp.asarray(packed), NamedSharding(self.mesh, self._seed_spec())
         )
-        params, opt_state, loss, routed_ov, tier_hits = self._step(
+        params, opt_state, loss, routed_ov, tier_hits, sample_ov = self._step(
             params, opt_state, self.topo, self._feature_parts(), packed,
             labels, key
         )
         self.last_routed_overflow = routed_ov
         self.last_tier_hits = tier_hits
+        self.last_sample_overflow = sample_ov
         if isinstance(feature, ShardedFeature):
             # hand the batch totals to the store so its eager split tuner
             # sees the fused path's traffic too
@@ -455,15 +553,15 @@ class DistributedTrainer:
             def body(carry, xs):
                 p, o = carry
                 seeds, k = xs
-                p, o, loss, routed_ov, hits = step(
+                p, o, loss, routed_ov, hits, sample_ov = step(
                     p, o, topo, parts, seeds, labels, k
                 )
-                return (p, o), (loss, routed_ov, hits)
+                return (p, o), (loss, routed_ov, hits, sample_ov)
 
-            (p, o), (losses, routed_ovs, hits) = jax.lax.scan(
+            (p, o), (losses, routed_ovs, hits, sample_ovs) = jax.lax.scan(
                 body, (params, opt_state), (seed_mat, keys)
             )
-            return p, o, losses, routed_ovs, hits
+            return p, o, losses, routed_ovs, hits, sample_ovs
 
         return fn  # jit's shape-keyed cache handles distinct step counts
 
@@ -486,17 +584,58 @@ class DistributedTrainer:
         epoch (one compiled program); the eager tuner moves it between
         epochs.
         """
+        self._maybe_grow_routed_alpha()
         packed = jax.device_put(
             jnp.asarray(seed_mat),
             NamedSharding(self.mesh, P(None, *self._seed_spec())),
         )
-        params, opt_state, losses, routed_ovs, tier_hits = self._epoch_fn(
+        (params, opt_state, losses, routed_ovs, tier_hits,
+         sample_ovs) = self._epoch_fn(
             params, opt_state, self.topo, self._feature_parts(), packed,
             labels, key
         )
         self.last_routed_overflow = routed_ovs
         self.last_tier_hits = tier_hits
+        self.last_sample_overflow = sample_ovs
         return params, opt_state, losses
+
+    def _maybe_grow_routed_alpha(self) -> None:
+        """Shared eager routing tuner (``auto_alpha=True``): the sampler's
+        per-hop routing and the feature gather draw on ONE budget, so one
+        tuner reads both overflow telemetries. If the PREVIOUS eager batch
+        fallback-served any lanes (feature ``last_routed_overflow`` or
+        sampler ``last_sample_overflow``), double ``routed_alpha`` (capped
+        at F — full-length buckets) and rebuild the step program. Overflow
+        lanes were served exactly, so this only trades one retrace for less
+        fallback comm on later batches."""
+        if not self.auto_alpha or self.routed_alpha is None:
+            return
+        if self.routed_alpha >= self.feature_size:
+            return
+        total = 0
+        for v in (self.last_routed_overflow, self.last_sample_overflow):
+            if v is None:
+                continue
+            try:
+                total += int(np.asarray(v).sum())
+            except Exception:  # noqa: BLE001 — a deleted/donated buffer
+                continue  # must not break the next step
+        if total <= 0:
+            return
+        old = self.routed_alpha
+        self.routed_alpha = min(old * 2.0, float(self.feature_size))
+        from ..utils.trace import get_logger
+
+        get_logger().info(
+            "shared routing budget: %d lanes fallback-served last batch "
+            "(feature gather + sampler hops); growing alpha %.2f -> %.2f "
+            "(one retrace)",
+            total, old, self.routed_alpha,
+        )
+        self.last_routed_overflow = None
+        self.last_sample_overflow = None
+        self._step = self._build()
+        self._epoch_fn = self._build_epoch()
 
 
 class DataParallelTrainer:

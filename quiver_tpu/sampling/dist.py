@@ -1,0 +1,501 @@
+"""Distributed neighbor sampling over a mesh-sharded topology.
+
+The scale-out counterpart of ``GraphSageSampler``: the graph lives as a
+:class:`~quiver_tpu.core.sharded_topology.ShardedTopology` (contiguous
+row ranges of the CSR across the mesh's ``feature`` axis, ~1/F topology
+bytes per chip) and every device is a full sampling worker over its own
+seed block. Each hop runs inside ``shard_map``:
+
+1. route every frontier vertex to its owning shard with the PR 1
+   capped-bucket ``all_to_all`` (``parallel/routing.py`` — the SAME
+   audited code path the sharded feature gather uses);
+2. the owner answers the vertex's degree (one capped hop back);
+3. the requester draws the per-vertex sample offsets with the EXACT
+   stratified+rotation scheme of the replicated kernel
+   (``ops/sample.py`` ``stratified_offsets``/``rotate_offsets``, same key,
+   same shapes — this is what makes the distributed sampler bit-identical
+   to the replicated one);
+4. the offsets ride the same buckets to the owner, which gathers the
+   neighbor ids from its local CSR slice and routes the ``(cap, k)``
+   neighbor blocks back.
+
+Bucket overflow is detected in-program and served EXACTLY via the
+cond-gated psum fallback (never silent, never wrong), counted, and
+surfaced as ``last_sample_overflow`` — the sampling sibling of
+``last_routed_overflow``/``last_tier_hits``.
+
+Comm model (L = per-device frontier width, F = shards, k = fanout,
+``cap = ceil(alpha * L / F)``): the four ``all_to_all`` hops move
+``F*cap``, ``F*cap``, ``F*cap*k`` and ``F*cap*k`` lanes — ``~alpha * L *
+(2 + 2k)`` total vs the exact-safe full-length ``F * L * (2 + 2k)``; the
+id lanes of the second exchange are not re-sent (the route plan caches
+them).
+
+Bit-parity contract: for the same seed block, PRNG key, fanouts, frontier
+caps, and dedup strategy, every per-worker ``SampleOutput`` (n_id, adjs)
+is bit-identical to the replicated ``GraphSageSampler``'s on that block
+with key ``fold_in(key, worker_index)`` — capping and routing change which
+wires the bits cross, never the bits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.config import SampleMode
+from ..core.sharded_topology import ShardedTopology
+from ..core.topology import CSRTopo
+from ..ops.reindex import reindex_layer, resolve_dedup
+from ..ops.sample import rotate_offsets, stratified_offsets
+from ..parallel.mesh import FEATURE_AXIS, shard_map
+from ..parallel.routing import BucketRoute
+from ..utils.trace import trace_scope
+from .sampler import Adj, GraphSageSampler, SampleOutput, _round_up
+
+__all__ = [
+    "DistGraphSageSampler",
+    "dist_sample_layer",
+    "dist_multilayer_sample",
+    "routed_sample_cap",
+]
+
+
+def routed_sample_cap(length: int, num_shards: int,
+                      alpha: float | None) -> int | None:
+    """Per-destination bucket capacity for a frontier of width ``length``:
+    ``ceil(alpha * L / F)`` clamped to [1, L]; ``None`` (or a cap >= L)
+    means the exact-safe full-length buckets."""
+    if alpha is None:
+        return None
+    if alpha <= 0:
+        raise ValueError(f"alpha must be > 0, got {alpha}")
+    cap = -(-int(alpha * length) // max(num_shards, 1))
+    cap = max(1, min(cap, int(length)))
+    return None if cap >= length else cap
+
+
+def _worker_index(mesh):
+    """Flat worker index over every mesh axis (axis-name order) — the same
+    fold-in scheme the seed_sharding="all" trainer uses."""
+    idx = jnp.zeros((), jnp.int32)
+    for a in mesh.axis_names:
+        idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+    return idx
+
+
+def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
+                      seeds, num_seeds, k: int, key, *, axis: str,
+                      num_shards: int, cap: int | None):
+    """One distributed hop (per-device body; call inside ``shard_map``).
+
+    Args:
+      local_indptr: (rows_per_shard + 1,) this shard's rebased indptr.
+      local_indices: (padded_edges,) this shard's CSR indices slice.
+      seeds: (S,) node ids, -1 padded (valid entries occupy a prefix).
+      num_seeds: scalar count of valid seeds.
+      k: static fanout.
+      key: PRNG key — consumed exactly like the replicated
+        ``sample_layer`` (split into jitter/rotation streams over the same
+        (S, k) shapes), which is what makes results bit-identical.
+      axis / num_shards: the mesh axis the topology is sharded over.
+      cap: per-destination routed-bucket capacity (None = uncapped).
+
+    Returns (neighbors (S, k) int32 -1-masked, counts (S,), overflow
+    scalar — the axis-group total of fallback-served lanes).
+    """
+    S = seeds.shape[0]
+    valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
+    s = jnp.where(valid, seeds, 0)
+    my = jax.lax.axis_index(axis)
+    E_local = local_indices.shape[0]
+
+    def _mine_local(ids):
+        # ownership-masked local row index — zero answers for lanes this
+        # shard does not own make the route's psum fallback exact
+        mine = (ids >= 0) & (ids // rows_per_shard == my)
+        return mine, jnp.where(mine, ids - my * rows_per_shard, 0)
+
+    def serve_deg(ids):
+        mine, r = _mine_local(ids)
+        deg = (local_indptr[r + 1] - local_indptr[r]).astype(jnp.int32)
+        return jnp.where(mine, deg, 0)
+
+    def serve_nbr(ids, offs):
+        mine, r = _mine_local(ids)
+        base = local_indptr[r].astype(jnp.int64) if E_local > np.iinfo(
+            np.int32).max else local_indptr[r].astype(jnp.int32)
+        epos = base[:, None] + offs.astype(base.dtype)
+        nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
+        return jnp.where(mine[:, None], nbr, 0).astype(jnp.int32)
+
+    route = BucketRoute(
+        s, valid, s // rows_per_shard, axis=axis, num_shards=num_shards,
+        cap=cap,
+    )
+    # hop pair 1: ids out, degrees back — the requester needs deg to draw
+    # the same offsets the replicated kernel would
+    deg = route.exchange(serve_deg)
+    # identical draw scheme/key discipline as ops.sample.sample_layer
+    kj, kr = jax.random.split(key)
+    off_nr, mask_sel = stratified_offsets(kj, deg, k)
+    off = rotate_offsets(kr, off_nr, deg, k)
+    mask = valid[:, None] & mask_sel
+    # hop pair 2: offsets out (same buckets, ids not re-sent), neighbor
+    # blocks back
+    nbr = route.exchange(serve_nbr, payload=off)
+    nbr = jnp.where(mask, nbr, -1).astype(jnp.int32)
+    counts = jnp.where(valid, jnp.minimum(deg, k), 0)
+    return nbr, counts, route.overflow
+
+
+def dist_multilayer_sample(local_indptr, local_indices, rows_per_shard: int,
+                           seeds, num_seeds, key, sizes, caps, *, axis: str,
+                           num_shards: int, routed_alpha: float | None = 2.0,
+                           dedup: str = "sort", node_count: int | None = None):
+    """Multi-layer distributed sample+reindex loop (per-device body).
+
+    The sharded-topology twin of ``sampling.sampler.multilayer_sample`` —
+    the reindex/Adj assembly is byte-for-byte the same discipline; only the
+    per-hop neighbor lookup is owner-routed. Returns the same tuple plus a
+    trailing ``hop_overflows``: per-hop fallback-served lane counts
+    (axis-group totals, seeds-outward order) — the ``last_sample_overflow``
+    telemetry source.
+    """
+    dedup = resolve_dedup(dedup)
+    adjs = []
+    edge_counts = []
+    frontier_counts = []
+    hop_overflows = []
+    cur, cur_n = seeds, num_seeds
+    total_overflow = jnp.zeros((), jnp.int32)
+    for l, k in enumerate(sizes):
+        key, sub = jax.random.split(key)
+        S = cur.shape[0]
+        cap = routed_sample_cap(S, num_shards, routed_alpha)
+        with trace_scope(f"dist_sample_layer_{l}"):
+            nbr, counts, hop_ov = dist_sample_layer(
+                local_indptr, local_indices, rows_per_shard, cur, cur_n, k,
+                sub, axis=axis, num_shards=num_shards, cap=cap,
+            )
+        hop_overflows.append(hop_ov)
+        with trace_scope(f"reindex_layer_{l}"):
+            node_bound = node_count if dedup == "map" else None
+            frontier, n_frontier, col, overflow = reindex_layer(
+                cur, cur_n, nbr, caps[l], node_bound=node_bound,
+                scatter_free=(dedup == "scan"),
+            )
+        row = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, k))
+        row = jnp.where(col >= 0, row, -1)
+        edge_index = jnp.stack([col.reshape(-1), row.reshape(-1)])
+        adjs.append(Adj(edge_index, None, (caps[l], S), fanout=k))
+        del counts
+        edge_counts.append(jnp.sum((col >= 0).astype(jnp.int32)))
+        frontier_counts.append(n_frontier + overflow)
+        cur, cur_n = frontier, n_frontier
+        total_overflow = total_overflow + overflow
+    return (cur, cur_n, adjs[::-1], total_overflow,
+            tuple(edge_counts[::-1]), tuple(frontier_counts[::-1]),
+            tuple(hop_overflows))
+
+
+class DistGraphSageSampler(GraphSageSampler):
+    """K-hop sampler over a mesh-sharded topology.
+
+    Constructed directly or via ``GraphSageSampler(...,
+    topo_sharding="mesh", mesh=mesh)``. Every device of the mesh is a full
+    sampling worker over its own seed block (the ``seed_sharding="all"``
+    discipline); per-hop neighbor lookups route frontier vertices to the
+    shard owning their CSR row (see the module docstring for the comm
+    model and the bit-parity contract).
+
+    Constraints vs the replicated sampler: HBM mode, the ``xla`` kernel,
+    unweighted, no ``with_eid`` (those paths stay on the replicated
+    ``GraphSageSampler``; the sharded CSR slices carry neither weights nor
+    eid). ``routed_alpha`` is the shared capped-bucket routing budget —
+    ``cap = ceil(alpha * L / F)`` lanes per destination per hop; ``None``
+    = uncapped full-length buckets. The ``DistributedTrainer`` drives this
+    sampler and the sharded feature store with ONE alpha (one budget, one
+    tuner).
+
+    After an eager :meth:`sample`, ``last_sample_overflow`` holds the
+    per-hop fallback-served lane counts (int32 ``(num_layers,)`` device
+    vector, seeds-outward) — same telemetry discipline as
+    ``last_routed_overflow``.
+    """
+
+    def __init__(
+        self,
+        csr_topo: CSRTopo,
+        sizes,
+        device=None,
+        mode: str | SampleMode = SampleMode.HBM,
+        seed_capacity: int | None = None,
+        frontier_caps=None,
+        seed: int = 0,
+        weighted: bool = False,
+        auto_margin: float = 1.25,
+        kernel: str = "xla",
+        with_eid: bool = False,
+        dedup: str = "auto",
+        device_topo=None,
+        topo_sharding: str = "mesh",
+        mesh=None,
+        routed_alpha: float | None = 2.0,
+        axis: str = FEATURE_AXIS,
+    ):
+        if topo_sharding != "mesh":
+            raise ValueError(
+                f"DistGraphSageSampler is the topo_sharding='mesh' sampler; "
+                f"got topo_sharding={topo_sharding!r}"
+            )
+        if mesh is None:
+            raise ValueError("topo_sharding='mesh' requires mesh=")
+        if weighted:
+            raise NotImplementedError(
+                "weighted sampling over a sharded topology is not supported; "
+                "use the replicated GraphSageSampler"
+            )
+        if with_eid:
+            raise NotImplementedError(
+                "with_eid over a sharded topology is not supported; the "
+                "sharded CSR slices do not carry eid"
+            )
+        if str(kernel) != "xla":
+            raise ValueError(
+                f"topo_sharding='mesh' supports kernel='xla' only, got {kernel!r}"
+            )
+        if SampleMode.parse(mode) is not SampleMode.HBM:
+            raise ValueError(
+                "topo_sharding='mesh' requires mode='HBM': each shard's CSR "
+                "slice is device-resident (that is the point — per-chip "
+                "bytes shrink 1/F instead of staging through host)"
+            )
+        if device_topo is not None:
+            raise ValueError(
+                "device_topo cannot be combined with topo_sharding='mesh'"
+            )
+        if routed_alpha is not None and routed_alpha <= 0:
+            raise ValueError(
+                f"routed_alpha must be > 0 or None, got {routed_alpha}"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.routed_alpha = (
+            None if routed_alpha is None else float(routed_alpha)
+        )
+        # per-hop fallback-served lane counts of the last eager sample
+        # (int32 (num_layers,) device vector; None before any)
+        self.last_sample_overflow = None
+        super().__init__(
+            csr_topo, sizes, device=device, mode=mode,
+            seed_capacity=seed_capacity, frontier_caps=frontier_caps,
+            seed=seed, weighted=weighted, auto_margin=auto_margin,
+            kernel=kernel, with_eid=with_eid, dedup=dedup,
+        )
+        self.topo_sharding = "mesh"
+
+    # -- topology placement (overrides the replicated upload) ---------------
+
+    def _init_topo(self, device_topo):
+        return ShardedTopology(self.mesh, self.csr_topo, axis=self.axis)
+
+    @property
+    def workers(self) -> int:
+        """Seed-block workers: every device of the mesh."""
+        w = 1
+        for a in self.mesh.axis_names:
+            w *= self.mesh.shape[a]
+        return w
+
+    # -- compiled program ---------------------------------------------------
+
+    def _compiled(self, seed_cap: int):
+        caps = self._caps_for(seed_cap)
+        cache_key = (seed_cap, caps, self.routed_alpha)
+        if cache_key in self._compiled_cache:
+            return self._compiled_cache[cache_key]
+        mesh, axis = self.mesh, self.axis
+        F = mesh.shape[axis]
+        sizes, dedup = self.sizes, self.dedup
+        alpha = self.routed_alpha
+        n = self.csr_topo.node_count
+        rps = self.topo.rows_per_shard
+        ids_axes = tuple(mesh.axis_names)
+        other_axes = tuple(a for a in mesh.axis_names if a != axis)
+        n_layers = len(sizes)
+
+        def body(indptr_blk, indices_blk, seeds, key):
+            key = jax.random.fold_in(key, _worker_index(mesh))
+            num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
+            (n_id, n_count, adjs, overflow, e_cnts, f_cnts,
+             hop_ovs) = dist_multilayer_sample(
+                indptr_blk[0], indices_blk[0], rps, seeds, num_seeds, key,
+                sizes, caps, axis=axis, num_shards=F, routed_alpha=alpha,
+                dedup=dedup, node_count=n,
+            )
+            eis = tuple(a.edge_index for a in adjs)
+            # per-worker scalar row: [n_count, frontier_overflow,
+            # edge_counts (deepest-first), frontier_counts (deepest-first)]
+            scal = jnp.stack(
+                [n_count, overflow] + list(e_cnts) + list(f_cnts)
+            ).astype(jnp.int32)
+            hop_ov = jnp.stack(hop_ovs)  # (L,) axis-group totals
+            if other_axes:  # replicate the mesh-wide totals
+                hop_ov = jax.lax.psum(hop_ov, other_axes)
+            return n_id, eis, scal, hop_ov
+
+        run = jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(axis, None), P(axis, None), P(ids_axes), P()),
+                out_specs=(
+                    P(ids_axes),
+                    tuple(P(None, ids_axes) for _ in range(n_layers)),
+                    P(ids_axes),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )
+        self._compiled_cache[cache_key] = (run, caps)
+        return run, caps
+
+    # -- public API ---------------------------------------------------------
+
+    def shard_seeds(self, seeds, local_cap: int) -> np.ndarray:
+        """Split a global seed array into per-worker valid-prefix blocks,
+        padded to (workers, local_cap) with -1 (same packing as the
+        seed_sharding="all" trainer)."""
+        seeds = np.asarray(seeds)
+        blocks = np.array_split(seeds, self.workers)
+        out = np.full((self.workers, local_cap), -1, np.int32)
+        for i, b in enumerate(blocks):
+            if len(b) > local_cap:
+                raise ValueError(
+                    f"per-worker block {len(b)} exceeds capacity {local_cap}"
+                )
+            out[i, : len(b)] = b
+        return out
+
+    def sample(self, input_nodes, key=None) -> SampleOutput:
+        """Sample k-hop neighborhoods of a GLOBAL seed batch, split across
+        every device of the mesh.
+
+        Returns one worker-major global ``SampleOutput``: ``n_id`` is
+        ``(workers * frontier_cap,)`` (each worker's block bit-identical
+        to the replicated sampler's on that worker's seed block — see
+        :meth:`sample_per_worker`), each ``adjs[l].edge_index`` is
+        ``(2, workers * E_l)`` with per-worker ``Adj.size``/``fanout``,
+        ``batch_size`` is the per-worker padded block width, ``n_count``/
+        ``overflow``/``edge_counts`` are mesh totals and
+        ``frontier_counts`` per-layer worker maxima. ``key`` overrides the
+        sampler's own PRNG stream (each worker folds in its flat worker
+        index on top).
+        """
+        seeds = np.asarray(input_nodes)
+        batch = int(seeds.shape[0])
+        if batch and (seeds.min() < 0
+                      or seeds.max() >= self.csr_topo.node_count):
+            raise ValueError(
+                f"seed ids must be in [0, {self.csr_topo.node_count}); "
+                f"got range [{seeds.min()}, {seeds.max()}]"
+            )
+        W = self.workers
+        per_worker = -(-batch // W) if batch else 1
+        cap = self._seed_capacity or max(_round_up(per_worker, 128), 128)
+        packed = self.shard_seeds(seeds, cap)
+        if key is None:
+            self._call += 1
+            key = jax.random.fold_in(self._key, self._call)
+        dev_seeds = jax.device_put(
+            jnp.asarray(packed.reshape(-1)),
+            NamedSharding(self.mesh, P(tuple(self.mesh.axis_names))),
+        )
+        run, used_caps = self._compiled(cap)
+        n_id, eis, scal, hop_ov = run(
+            self.topo.indptr, self.topo.indices, dev_seeds, key
+        )
+        if self._auto_caps:
+            n_layers = len(self.sizes)
+            first_plan = self._frontier_caps is None
+            for _ in range(n_layers + 2):
+                sc = np.asarray(scal).reshape(W, 2 + 2 * n_layers)
+                overflow = int(sc[:, 1].sum())
+                if not first_plan and overflow == 0:
+                    break
+                # per-layer unclipped uniques, seeds-outward, worker max —
+                # caps must cover the worst worker (one uniform program)
+                observed = sc[:, 2 + n_layers:][:, ::-1].max(axis=0)
+                before = self._frontier_caps
+                self._plan_auto(cap, [int(o) for o in observed])
+                if self._frontier_caps != before:
+                    from ..utils.trace import get_logger
+
+                    get_logger().info(
+                        "dist auto caps %s: %s -> %s (recompile)",
+                        "planned" if before is None else "regrown",
+                        before, self._frontier_caps,
+                    )
+                if not first_plan and self._frontier_caps == before:
+                    break  # saturated: clipped result + overflow stand
+                if first_plan and overflow == 0:
+                    first_plan = False
+                    break
+                run, used_caps = self._compiled(cap)
+                n_id, eis, scal, hop_ov = run(
+                    self.topo.indptr, self.topo.indices, dev_seeds, key
+                )
+                first_plan = False
+        self.last_sample_overflow = hop_ov
+        return self._assemble(n_id, eis, scal, cap, used_caps, batch)
+
+    def _assemble(self, n_id, eis, scal, seed_cap, caps, batch):
+        W = self.workers
+        n_layers = len(self.sizes)
+        sc = np.asarray(scal).reshape(W, 2 + 2 * n_layers)
+        # adjs deepest-first; per-layer frontier widths seeds-outward are
+        # (seed_cap, caps[0], ..., caps[-2])
+        widths = (seed_cap,) + tuple(caps[:-1])
+        adjs = [
+            Adj(ei, None, (caps[l], widths[l]), fanout=self.sizes[l])
+            for l, ei in zip(range(n_layers - 1, -1, -1), eis)
+        ]
+        e_cnts = tuple(int(c) for c in sc[:, 2:2 + n_layers].sum(axis=0))
+        f_cnts = tuple(int(c) for c in sc[:, 2 + n_layers:].max(axis=0))
+        return SampleOutput(
+            n_id, seed_cap, adjs,
+            jnp.int32(int(sc[:, 0].sum())), jnp.int32(int(sc[:, 1].sum())),
+            e_cnts, f_cnts,
+        )
+
+    def sample_per_worker(self, input_nodes, key=None) -> list[SampleOutput]:
+        """:meth:`sample`, sliced into per-worker ``SampleOutput``s — each
+        bit-comparable to the replicated ``GraphSageSampler``'s output on
+        that worker's seed block with key
+        ``fold_in(base_key, worker_index)``."""
+        seeds = np.asarray(input_nodes)
+        out = self.sample(seeds, key=key)
+        W = self.workers
+        n_layers = len(self.sizes)
+        cap_last = out.n_id.shape[0] // W
+        n_id = np.asarray(out.n_id).reshape(W, cap_last)
+        blocks = np.array_split(seeds, W)
+        per = []
+        for w in range(W):
+            adjs_w = []
+            for a in out.adjs:
+                E_l = a.edge_index.shape[1] // W
+                ei = jnp.asarray(
+                    np.asarray(a.edge_index).reshape(2, W, E_l)[:, w]
+                )
+                adjs_w.append(Adj(ei, None, a.size, fanout=a.fanout))
+            per.append(SampleOutput(
+                jnp.asarray(n_id[w]), len(blocks[w]), adjs_w,
+                jnp.int32(0), jnp.int32(0), (), (),
+            ))
+        return per

@@ -209,14 +209,34 @@ class GraphSageSampler:
         reindex.cu.hpp:120-139), or "scan" (zero-scatter: sorts +
         cumulative max + gathers only — for backends where XLA scatter
         serializes). Identical results. Default "auto" picks per platform
-        (ops.reindex.resolve_dedup: cpu->map measured, tpu->scan;
-        QUIVER_DEDUP overrides).
+        (ops.reindex.resolve_dedup: cpu->map measured, tpu->scan).
+        ``QUIVER_DEDUP`` overrides the "auto" resolution ONLY — an
+        explicit strategy here keeps what it names (the ignored force is
+        logged once; see resolve_dedup).
       device_topo: advanced — reuse an existing DeviceTopology (built with
         compatible to_device flags) instead of uploading a fresh copy;
         lets many sampler configurations share one device-resident graph.
       device: accepted-and-INERT parity slot (the reference pins a CUDA
         ordinal, sage_sampler.py:26; under SPMD the mesh owns placement).
+      topo_sharding: ``"replicated"`` (default — every chip holds the full
+        CSR) or ``"mesh"`` — the graph itself is partitioned across the
+        mesh's feature axis (~1/F topology bytes per chip) and sampling
+        routes each frontier vertex to its owning shard over capped-bucket
+        all_to_all collectives. ``"mesh"`` construction returns a
+        :class:`~quiver_tpu.sampling.dist.DistGraphSageSampler` and
+        requires ``mesh=``; results are bit-identical to the replicated
+        sampler per worker block.
     """
+
+    def __new__(cls, *args, **kwargs):
+        # GraphSageSampler(topo_sharding="mesh", mesh=...) constructs the
+        # sharded-topology sampler — one entry point, two placements
+        if (cls is GraphSageSampler
+                and kwargs.get("topo_sharding", "replicated") == "mesh"):
+            from .dist import DistGraphSageSampler
+
+            return super().__new__(DistGraphSageSampler)
+        return super().__new__(cls)
 
     def __init__(
         self,
@@ -233,7 +253,16 @@ class GraphSageSampler:
         with_eid: bool = False,
         dedup: str = "auto",
         device_topo=None,
+        topo_sharding: str = "replicated",
     ):
+        if topo_sharding not in ("replicated", "mesh"):
+            raise ValueError(
+                f"topo_sharding must be 'replicated' or 'mesh', "
+                f"got {topo_sharding!r}"
+            )
+        # "mesh" never reaches this __init__ (the __new__ dispatch hands
+        # construction to DistGraphSageSampler, which overrides it)
+        self.topo_sharding = "replicated"
         self.csr_topo = csr_topo
         self.mode = SampleMode.parse(mode)
         max_deg = csr_topo.max_degree
@@ -258,26 +287,7 @@ class GraphSageSampler:
                 "weighted=True requires edge weights; call "
                 "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
             )
-        if device_topo is not None:
-            # advanced: share one DeviceTopology across samplers (the
-            # reference shares one native quiver across sampler objects
-            # too); must have been built with to_device flags compatible
-            # with this sampler's mode/with_eid/weighted
-            if self.with_eid and getattr(device_topo, "eid", None) is None:
-                raise ValueError(
-                    "device_topo lacks eid but with_eid=True; rebuild with "
-                    "to_device(with_eid=True)"
-                )
-            if self.weighted and getattr(device_topo, "cum_weights", None) is None:
-                raise ValueError(
-                    "device_topo lacks cum_weights but weighted=True; "
-                    "rebuild with to_device(with_weights=True)"
-                )
-            self.topo = device_topo
-        else:
-            self.topo = csr_topo.to_device(
-                self.mode, with_eid=self.with_eid, with_weights=self.weighted
-            )
+        self.topo = self._init_topo(device_topo)
         self._seed_capacity = seed_capacity
         self._auto_caps = frontier_caps == "auto"
         self._auto_margin = float(auto_margin)
@@ -311,6 +321,30 @@ class GraphSageSampler:
                 device,
             )
         self._compiled_cache = {}
+
+    def _init_topo(self, device_topo):
+        """Build (or adopt) the device-resident topology. The mesh-sharded
+        sampler overrides this to partition the CSR instead of uploading a
+        full replica."""
+        if device_topo is not None:
+            # advanced: share one DeviceTopology across samplers (the
+            # reference shares one native quiver across sampler objects
+            # too); must have been built with to_device flags compatible
+            # with this sampler's mode/with_eid/weighted
+            if self.with_eid and getattr(device_topo, "eid", None) is None:
+                raise ValueError(
+                    "device_topo lacks eid but with_eid=True; rebuild with "
+                    "to_device(with_eid=True)"
+                )
+            if self.weighted and getattr(device_topo, "cum_weights", None) is None:
+                raise ValueError(
+                    "device_topo lacks cum_weights but weighted=True; "
+                    "rebuild with to_device(with_weights=True)"
+                )
+            return device_topo
+        return self.csr_topo.to_device(
+            self.mode, with_eid=self.with_eid, with_weights=self.weighted
+        )
 
     # -- static-shape planning ---------------------------------------------
 

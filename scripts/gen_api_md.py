@@ -18,15 +18,21 @@ sys.path.insert(0, REPO)
 SECTIONS = [
     ("quiver_tpu", "Package root (reference: quiver/__init__.py exports)"),
     ("quiver_tpu.core.topology", "Graph topology (CSRTopo, device placement)"),
+    ("quiver_tpu.core.sharded_topology",
+     "Mesh-sharded topology (CSR partitioned across chips)"),
     ("quiver_tpu.core.config", "Config enums + byte-size parser"),
     ("quiver_tpu.core.memory", "Device/host memory placement"),
     ("quiver_tpu.sampling.sampler", "GraphSageSampler (homo)"),
+    ("quiver_tpu.sampling.dist",
+     "Distributed sampler over a mesh-sharded topology"),
     ("quiver_tpu.sampling.hetero", "Heterogeneous sampler"),
     ("quiver_tpu.sampling.saint", "GraphSAINT samplers"),
     ("quiver_tpu.feature.feature", "Tiered feature store"),
     ("quiver_tpu.feature.shard", "Mesh-sharded feature store"),
     ("quiver_tpu.models", "Model families + layer-wise inference"),
     ("quiver_tpu.parallel.mesh", "Device mesh / clique topology"),
+    ("quiver_tpu.parallel.routing",
+     "Capped-bucket owner routing (shared comm core)"),
     ("quiver_tpu.parallel.trainer", "Distributed fused trainer"),
     ("quiver_tpu.parallel.train", "Single-chip train step helpers"),
     ("quiver_tpu.parallel.pipeline", "Prefetcher"),

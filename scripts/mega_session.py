@@ -74,6 +74,7 @@ ORDER = [
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),
     ("feature-threetier", 900),
+    ("sampler-sharded", 900),
     ("acceptance", 1800),
     ("sweep", 2400),
 ]

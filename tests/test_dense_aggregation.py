@@ -128,13 +128,24 @@ def test_trainer_fused_step_rebuilds_fanout_correctly():
 
 
 def test_occurrence_counts_strategies_agree(monkeypatch):
-    from quiver_tpu.models.layers import occurrence_counts
+    from quiver_tpu.models import layers
 
     rng = np.random.default_rng(2)
     ids = jnp.asarray(rng.integers(0, 40, 500))
     valid = jnp.asarray(rng.random(500) < 0.6)
+    # the strategy is pinned once per process (ADVICE #1: no trace-time env
+    # reads inside jitted model code), so flipping QUIVER_COUNTS requires
+    # resetting the cache — which is exactly what a live model can NOT do
     monkeypatch.setenv("QUIVER_COUNTS", "scan")
-    a = np.asarray(occurrence_counts(ids, valid, 40))
+    monkeypatch.setattr(layers, "_counts_strategy", None)
+    a = np.asarray(layers.occurrence_counts(ids, valid, 40))
+    assert layers.resolve_counts_strategy() == "scan"
     monkeypatch.setenv("QUIVER_COUNTS", "scatter")
-    b = np.asarray(occurrence_counts(ids, valid, 40))
+    # without a reset the pinned strategy stays — env after first trace is
+    # inert by contract
+    assert layers.resolve_counts_strategy() == "scan"
+    monkeypatch.setattr(layers, "_counts_strategy", None)
+    b = np.asarray(layers.occurrence_counts(ids, valid, 40))
+    assert layers.resolve_counts_strategy() == "scatter"
     np.testing.assert_array_equal(a, b)
+    monkeypatch.setattr(layers, "_counts_strategy", None)  # leave no pin
