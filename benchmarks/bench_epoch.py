@@ -222,12 +222,12 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
     import jax
 
     from quiver_tpu import DistributedTrainer, GraphSageSampler
-    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
 
     n = topo.node_count
     mesh = make_mesh()
-    workers = mesh.shape["data"] * (
-        mesh.shape["feature"] if args.seed_sharding == "all" else 1
+    workers = mesh.shape[DATA_AXIS] * (
+        mesh.shape[FEATURE_AXIS] if args.seed_sharding == "all" else 1
     )
     # ceil: shard_seeds' first blocks get ceil(batch/workers) seeds
     local_batch = -(-args.batch // workers)
@@ -276,12 +276,12 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
     import jax
 
     from quiver_tpu import DistributedTrainer, GraphSageSampler
-    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
 
     n = topo.node_count
     mesh = make_mesh()
-    workers = mesh.shape["data"] * (
-        mesh.shape["feature"] if args.seed_sharding == "all" else 1
+    workers = mesh.shape[DATA_AXIS] * (
+        mesh.shape[FEATURE_AXIS] if args.seed_sharding == "all" else 1
     )
     local_batch = -(-args.batch // workers)
     sampler = GraphSageSampler(

@@ -140,7 +140,10 @@ def resolve_gather_kernel(kernel: str) -> str:
     disk (keyed by device kind), and ``QUIVER_GATHER_KERNEL=pallas|xla``
     overrides it. Off-TPU auto is xla (the Pallas CPU path is correct but
     slow). An explicit ``kernel="pallas"`` bypasses everything (fail loudly
-    on request).
+    on request). Env-before-first-use: both knobs (the force and
+    ``QUIVER_ELECTION_CACHE``) are resolved ONCE per process at the first
+    auto resolution — set them before the first gather; flipping them
+    afterwards is inert (tests/test_kernel_election.py pins this).
     """
     validate_gather_kernel(kernel)
     if kernel == "auto":
@@ -237,13 +240,43 @@ def _election_cache_key() -> str:
     )
 
 
-def _election_cache_path() -> str:
-    import os
+_ELECTION_CACHE_PATH: str | None = None
 
-    return os.environ.get(
-        "QUIVER_ELECTION_CACHE",
-        os.path.expanduser("~/.cache/quiver_tpu/gather_election.json"),
-    )
+
+def _election_cache_path() -> str:
+    """Disk-cache path for the kernel election (``QUIVER_ELECTION_CACHE``),
+    resolved ONCE per process. Env-before-first-use: the election runs
+    behind the first ``kernel="auto"`` gather — which may sit inside a
+    traced body, where a per-call env read would freeze at first trace
+    while looking live (graftlint env-at-trace). Tests reset
+    ``_ELECTION_CACHE_PATH`` to re-resolve."""
+    global _ELECTION_CACHE_PATH
+    if _ELECTION_CACHE_PATH is None:
+        import os
+
+        _ELECTION_CACHE_PATH = os.environ.get(
+            "QUIVER_ELECTION_CACHE",
+            os.path.expanduser("~/.cache/quiver_tpu/gather_election.json"),
+        )
+    return _ELECTION_CACHE_PATH
+
+
+_FORCED_GATHER_KERNEL: str | None = None
+
+
+def _forced_gather_kernel() -> str:
+    """The ``QUIVER_GATHER_KERNEL`` force ("" = none), read ONCE per
+    process — the same env-before-first-use contract as
+    ``models/layers.resolve_counts_strategy``: set it before the first
+    ``kernel="auto"`` resolution (chip-window forcing precedes the first
+    gather). Tests reset ``_FORCED_GATHER_KERNEL`` to re-resolve."""
+    global _FORCED_GATHER_KERNEL
+    if _FORCED_GATHER_KERNEL is None:
+        import os
+
+        _FORCED_GATHER_KERNEL = os.environ.get(
+            "QUIVER_GATHER_KERNEL", "").strip().lower()
+    return _FORCED_GATHER_KERNEL
 
 
 def _elect_gather_kernel() -> str:
@@ -257,7 +290,7 @@ def _elect_gather_kernel() -> str:
     if _GATHER_ELECTION is not None:
         return _GATHER_ELECTION["kernel"]
     log = get_logger("feature")
-    forced = os.environ.get("QUIVER_GATHER_KERNEL", "").strip().lower()
+    forced = _forced_gather_kernel()
     if forced in ("pallas", "xla"):
         _GATHER_ELECTION = {"kernel": forced, "how": "env override"}
         return forced

@@ -140,9 +140,11 @@ class ShardedTensor(KernelChoice):
         if a <= 0:
             raise ValueError(f"alpha must be > 0, got {a}")
         cap = math.ceil(a * length / max(self.num_shards, 1))
+        # graftlint: disable=host-op-on-tracer -- L is the static lane width
         return max(1, min(int(cap), int(length)))
 
-    def _maybe_grow_routed_alpha(self) -> None:
+    # graftlint: eager -- between-batch tuner; under trace int() raises and
+    def _maybe_grow_routed_alpha(self) -> None:  # the except returns early
         """Auto-tuner step for eager capped gathers: if the PREVIOUS capped
         batch overflowed its buckets, double ``routed_alpha`` (capped at F
         — full-length buckets) before planning this batch's cap. Reading
@@ -634,7 +636,8 @@ class ShardedFeature(KernelChoice):
         self._rep_ceiling_rows = max(self._rep_ceiling_rows, rows)
         self.resplit(rows)
 
-    def _maybe_auto_split(self) -> None:
+    # graftlint: eager -- between-batch split tuner; under trace the hits
+    def _maybe_auto_split(self) -> None:  # int() raises and except returns
         """Move the L0/L1 boundary toward the measured hit distribution.
 
         Consumes ``last_tier_hits`` (the previous eager batch — long

@@ -65,11 +65,23 @@ def resolve_counts_strategy() -> str:
     return _counts_strategy
 
 
+_check_cache: bool | None = None
+
+
 def _check_enabled() -> bool:
-    """QUIVER_CHECK=1 turns on the debug-mode layout assertions."""
-    return os.environ.get("QUIVER_CHECK", "0") not in (
-        "", "0", "false", "False"
-    )
+    """QUIVER_CHECK=1 turns on the debug-mode layout assertions.
+
+    Resolved ONCE per process (graftlint env-at-trace): the check gate is
+    evaluated inside traced aggregation code, where a per-call env read
+    would freeze at first trace anyway while looking like a live switch.
+    Set QUIVER_CHECK before the first model trace; tests reset
+    ``_check_cache`` to re-resolve."""
+    global _check_cache
+    if _check_cache is None:
+        _check_cache = os.environ.get("QUIVER_CHECK", "0") not in (
+            "", "0", "false", "False"
+        )
+    return _check_cache
 
 
 def _raise_layout_violation(count):

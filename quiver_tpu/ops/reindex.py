@@ -27,6 +27,25 @@ __all__ = [
 
 DEDUP_STRATEGIES = ("sort", "map", "scan")
 
+# QUIVER_DEDUP resolution caches — ONE env read per process each.
+# resolve_dedup is reachable from traced code (dist_multilayer_sample /
+# multilayer_sample call it inside shard_map'd bodies), where a per-call
+# env read freezes at first trace while looking like a live switch (the
+# QUIVER_COUNTS bug class, graftlint env-at-trace). Set QUIVER_DEDUP
+# before the first sampler construction or trace; tests reset these.
+_forced_dedup: str | None = None
+_auto_dedup: str | None = None
+
+
+def _forced_dedup_env() -> str:
+    """The ``QUIVER_DEDUP`` force, read once per process ("" = no force)."""
+    global _forced_dedup
+    if _forced_dedup is None:
+        import os
+
+        _forced_dedup = os.environ.get("QUIVER_DEDUP", "").strip()
+    return _forced_dedup
+
 
 def resolve_dedup(dedup: str) -> str:
     """Resolve a dedup strategy name, mapping ``"auto"`` to the platform
@@ -49,11 +68,13 @@ def resolve_dedup(dedup: str) -> str:
     the first such ignored force is logged so the mismatch is visible.
     Unknown names raise — a typo must not silently fall back to a
     strategy (the callers' dispatch treats anything non-map/scan as sort).
+    Both the force and the "auto" resolution are pinned at FIRST use for
+    the process (env-before-first-use contract; this function runs inside
+    traced sampler bodies, where the env would freeze at first trace
+    regardless — the cache makes the once-semantics explicit).
     """
     if dedup in DEDUP_STRATEGIES:
-        import os
-
-        forced = os.environ.get("QUIVER_DEDUP", "").strip()
+        forced = _forced_dedup_env()
         if forced and forced != dedup:
             from ..utils.trace import info_once
 
@@ -68,12 +89,15 @@ def resolve_dedup(dedup: str) -> str:
         raise ValueError(
             f"dedup must be 'auto', 'sort', 'map', or 'scan', got {dedup!r}"
         )
-    from ..core.config import resolve_platform_strategy
+    global _auto_dedup
+    if _auto_dedup is None:
+        from ..core.config import resolve_platform_strategy
 
-    return resolve_platform_strategy(
-        "QUIVER_DEDUP", DEDUP_STRATEGIES, tpu_default="scan",
-        other_default="map",
-    )
+        _auto_dedup = resolve_platform_strategy(
+            "QUIVER_DEDUP", DEDUP_STRATEGIES, tpu_default="scan",
+            other_default="map",
+        )
+    return _auto_dedup
 
 
 def inverse_permutation(p):

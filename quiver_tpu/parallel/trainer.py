@@ -599,7 +599,8 @@ class DistributedTrainer:
         self.last_sample_overflow = sample_ovs
         return params, opt_state, losses
 
-    def _maybe_grow_routed_alpha(self) -> None:
+    # graftlint: eager -- between-batch tuner on host numpy telemetry; the
+    def _maybe_grow_routed_alpha(self) -> None:  # step program never calls it
         """Shared eager routing tuner (``auto_alpha=True``): the sampler's
         per-hop routing and the feature gather draw on ONE budget, so one
         tuner reads both overflow telemetries. If the PREVIOUS eager batch

@@ -73,7 +73,9 @@ def routed_sample_cap(length: int, num_shards: int,
         return None
     if alpha <= 0:
         raise ValueError(f"alpha must be > 0, got {alpha}")
+    # graftlint: disable=host-op-on-tracer -- L is the static lane width
     cap = -(-int(alpha * length) // max(num_shards, 1))
+    # graftlint: disable=host-op-on-tracer -- L is the static lane width
     cap = max(1, min(cap, int(length)))
     return None if cap >= length else cap
 

@@ -1,0 +1,7 @@
+"""Developer tooling shipped with the package.
+
+``tools.lint`` is graftlint — the trace-safety / collective-consistency
+static analyzer (``python -m quiver_tpu.tools.lint``). Tools here are
+stdlib-only at analysis time: they parse source with ``ast`` and never
+execute or import the code under analysis.
+"""

@@ -1,0 +1,38 @@
+"""graftlint — trace-safety and collective-consistency static analysis.
+
+AST-only (the analyzed code is never imported), seeded with rules distilled
+from bugs this repo actually shipped and fixed:
+
+* ``env-at-trace`` — ``os.environ`` reads reachable from jit/shard_map/
+  lax-control-flow bodies (the QUIVER_COUNTS bug): the value freezes at
+  first trace while looking like a live switch.
+* ``axis-name-consistency`` — collective/PartitionSpec axis names must use
+  the shared ``parallel/mesh.py`` constants; unknown literals are flagged
+  as drift.
+* ``cond-branch-parity`` — ``lax.cond`` branches returning mismatched
+  tuple structure (the psum-fallback pattern).
+* ``host-op-on-tracer`` — ``int()``/``.item()``/``range(len())`` on values
+  flowing from traced parameters.
+* ``per-call-logging-in-jit`` — logging in traced bodies that is not the
+  one-shot ``info_once`` idiom.
+* ``export-doc-drift`` — ``__all__`` exports missing from ``docs/API.md``.
+
+CLI: ``python -m quiver_tpu.tools.lint [paths]`` (``--json``,
+``--list-rules``, ``--select``, ``--ignore``; exit 0 clean / 1 findings /
+2 usage). Inline suppression: ``# graftlint: disable=<rule> -- <reason>``
+— the reason is mandatory.
+"""
+
+from .rules import Finding, RULES, rule_docs
+from .runner import LintResult, collect_files, lint_paths
+from .cli import main
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "RULES",
+    "collect_files",
+    "lint_paths",
+    "main",
+    "rule_docs",
+]

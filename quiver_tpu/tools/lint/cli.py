@@ -1,0 +1,73 @@
+"""graftlint CLI.
+
+    python -m quiver_tpu.tools.lint quiver_tpu/ scripts/ benchmarks/
+
+Exit codes (stable, for CI):
+  0 — clean (suppressed findings are fine)
+  1 — findings (including parse errors and bad suppressions)
+  2 — usage error (unknown rule, missing path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .rules import rule_docs
+from .runner import lint_paths
+
+__all__ = ["main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m quiver_tpu.tools.lint",
+        description="graftlint — trace-safety and collective-consistency "
+                    "static analysis for quiver_tpu",
+    )
+    p.add_argument("paths", nargs="*", default=["."],
+                   help="files or directories to lint (default: .)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rules to run (default: all)")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rules to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for name, doc in rule_docs().items():
+            first = doc.splitlines()[0] if doc else ""
+            print(f"{name}: {first}")
+        return 0
+    split = (lambda s: [r.strip() for r in s.split(",") if r.strip()])
+    try:
+        result = lint_paths(
+            args.paths,
+            select=split(args.select) if args.select else None,
+            ignore=split(args.ignore) if args.ignore else None,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=1))
+        return result.exit_code
+    for f in result.findings:
+        print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule}: {f.message}")
+    print(
+        f"graftlint: {len(result.findings)} finding(s) "
+        f"({len(result.suppressed)} suppressed) in "
+        f"{len(result.files)} file(s)"
+    )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

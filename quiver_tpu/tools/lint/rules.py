@@ -1,0 +1,458 @@
+"""graftlint rules — each distilled from a bug this repo actually shipped.
+
+Every rule is a function ``(project) -> list[Finding]`` registered in
+``RULES``. Rule names are the stable identifiers used by inline
+suppressions (``# graftlint: disable=<rule> -- <reason>``) and ``--select``
+/ ``--ignore``.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import dataclasses
+import os
+import re
+
+from .analysis import (
+    Project,
+    FuncInfo,
+    STATIC_ATTRS,
+    is_env_read,
+    iter_owned,
+    terminal_name,
+)
+
+__all__ = ["Finding", "RULES", "rule_docs"]
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "suppressed": self.suppressed}
+
+
+def _finding(rule, info_or_path, node, message) -> Finding:
+    path = (info_or_path if isinstance(info_or_path, str)
+            else info_or_path.path)
+    return Finding(rule=rule, path=path,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message)
+
+
+def _chain(info: FuncInfo) -> str:
+    hops = " -> ".join(info.trace_chain + (info.qualname,))
+    return f"{hops} [{info.trace_reason}]"
+
+
+# -- rule 1: env-at-trace -----------------------------------------------------
+
+def check_env_at_trace(project: Project) -> list[Finding]:
+    """``os.environ`` reads reachable from jit/shard_map/lax-control-flow
+    bodies. The env var silently freezes at first trace while looking like
+    a live switch (the QUIVER_COUNTS bug, fixed by hand in PR 3). Route the
+    read through a module-cached resolve-once helper instead
+    (``models/layers.resolve_counts_strategy`` over
+    ``core/config.resolve_platform_strategy``) and document the
+    env-before-first-use contract."""
+    out = []
+    for f in project.funcs:
+        if not f.traced or f.is_module:
+            continue
+        for node in iter_owned(f.node):
+            how = is_env_read(node)
+            if how:
+                out.append(_finding(
+                    "env-at-trace", f, node,
+                    f"{how} read inside traced code ({_chain(f)}); the "
+                    "value freezes at first trace while looking live — "
+                    "resolve it ONCE per process via a module-cached "
+                    "helper (cf. models/layers.resolve_counts_strategy) "
+                    "and document env-before-first-use",
+                ))
+    return out
+
+
+# -- rule 2: axis-name-consistency -------------------------------------------
+
+# collective -> index of the positional axis argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "all_to_all": 1, "psum_scatter": 1, "ppermute": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+_SPEC_CALLS = {"PartitionSpec", "P"}
+
+
+def _axis_literals(arg: ast.AST):
+    """String constants in an axis-argument expression (handles tuples)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        yield arg
+    elif isinstance(arg, (ast.Tuple, ast.List)):
+        for e in arg.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                yield e
+
+
+def check_axis_name_consistency(project: Project) -> list[Finding]:
+    """Axis names in collective calls / PartitionSpecs / ``mesh.shape[...]``
+    must come from the shared ``*_AXIS`` constants (``parallel/mesh.py``
+    declares ``DATA_AXIS``/``FEATURE_AXIS``); a string literal in axis
+    position is drift waiting to happen, and a literal matching NO declared
+    axis is drift that already happened."""
+    declared = project.declared_axes
+    by_value = {v: k for k, v in declared.items()}
+    if not declared:
+        return []  # nothing declared in the analyzed set — nothing to check
+
+    def msg_for(lit: str) -> str:
+        if lit in by_value:
+            return (f"hardcoded axis name {lit!r}; use the shared constant "
+                    f"{by_value[lit]} (quiver_tpu.parallel.mesh) so axis "
+                    "renames cannot drift")
+        known = ", ".join(sorted(f"{v!r} ({k})" for k, v in declared.items()))
+        return (f"axis name {lit!r} matches no declared mesh axis "
+                f"(declared: {known}) — string drift in a collective is a "
+                "silent wrong-group reduction")
+
+    out = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                axis_args = []
+                if t in _COLLECTIVES:
+                    pos = _COLLECTIVES[t]
+                    if pos < len(node.args):
+                        axis_args.append(node.args[pos])
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_name", "axis"):
+                            axis_args.append(kw.value)
+                elif t in _SPEC_CALLS:
+                    axis_args.extend(node.args)
+                for arg in axis_args:
+                    for lit in _axis_literals(arg):
+                        out.append(_finding("axis-name-consistency", src.path,
+                                            lit, msg_for(lit.value)))
+            elif isinstance(node, ast.Subscript):
+                # mesh.shape["data"] — flag only literals that ARE declared
+                # axes (unknown strings here are ordinary dict keys)
+                if (isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "shape"
+                        and isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, str)
+                        and node.slice.value in by_value):
+                    out.append(_finding("axis-name-consistency", src.path,
+                                        node.slice,
+                                        msg_for(node.slice.value)))
+    return out
+
+
+# -- rule 3: cond-branch-parity ----------------------------------------------
+
+def _return_arities(expr: ast.AST, scope: FuncInfo | None,
+                    project: Project) -> set:
+    """Possible return shapes of a cond branch: int = tuple arity,
+    "scalar" = a single non-tuple value. Empty set = not statically
+    analyzable (stay silent)."""
+    def expr_arity(e):
+        if isinstance(e, ast.Tuple):
+            return len(e.elts)
+        if e is None:
+            return 0
+        return "scalar"
+
+    if isinstance(expr, ast.Lambda):
+        return {expr_arity(expr.body)}
+    target = None
+    if isinstance(expr, ast.Name) and scope is not None:
+        s = scope
+        while s is not None:
+            if expr.id in s.local_funcs:
+                cands = s.local_funcs[expr.id]
+                target = cands[0] if len(cands) == 1 else None
+                break
+            if expr.id in s.local_names and not s.is_module:
+                break
+            s = s.parent
+        if target is None:
+            cands = project.index.get(expr.id, [])
+            target = cands[0] if len(cands) == 1 else None
+    if target is None or isinstance(target.node, ast.Lambda):
+        return set()
+    arities = set()
+    for node in iter_owned(target.node):
+        if isinstance(node, ast.Return):
+            arities.add(expr_arity(node.value))
+    return arities
+
+
+def check_cond_branch_parity(project: Project) -> list[Finding]:
+    """``lax.cond`` branches returning mismatched tuple arity — the
+    psum-fallback pattern (``parallel/routing.py``, ``feature/shard.py``)
+    duplicates a two-branch cond; editing one branch's return without the
+    other fails only at trace time, deep inside a shard_map stack."""
+    out = []
+    for src in project.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "cond" or len(node.args) < 3:
+                continue
+            scope = project.owner_of(node)
+            a_true = _return_arities(node.args[1], scope, project)
+            a_false = _return_arities(node.args[2], scope, project)
+            if a_true and a_false and not (a_true & a_false):
+                def show(s):
+                    return "/".join(str(x) for x in sorted(s, key=str))
+                out.append(_finding(
+                    "cond-branch-parity", src.path, node,
+                    f"lax.cond branches return mismatched structures "
+                    f"(true branch: {show(a_true)} value(s), false branch: "
+                    f"{show(a_false)}); both branches must return the same "
+                    "pytree structure or the cond fails at trace time",
+                ))
+    return out
+
+
+# -- rule 4: host-op-on-tracer -----------------------------------------------
+
+class _TaintWalk(ast.NodeVisitor):
+    """Minimal forward taint pass over one traced function's owned nodes."""
+
+    def __init__(self, func: FuncInfo):
+        self.func = func
+        self.tainted: set[str] = set(func.taint_params)
+        self.findings: list[Finding] = []
+
+    def _tainted(self, expr) -> bool:
+        if expr is None:
+            return False
+        # static metadata never carries a tracer
+        clean = _strip_static(expr)
+        for node in ast.walk(clean) if clean is not None else ():
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return True
+        return False
+
+    def run(self):
+        # iter_owned yields in traversal (not source) order, and loops can
+        # carry taint backwards — iterate the assignment scan to a
+        # fixpoint before checking call sites
+        nodes = sorted(
+            iter_owned(self.func.node),
+            key=lambda n: (getattr(n, "lineno", 0),
+                           getattr(n, "col_offset", 0)),
+        )
+        assigns = [n for n in nodes if isinstance(n, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for node in assigns:
+                if self._tainted(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if (isinstance(n, ast.Name)
+                                    and n.id not in self.tainted):
+                                self.tainted.add(n.id)
+                                changed = True
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+        return self.findings
+
+    def _check_call(self, node: ast.Call):
+        t = terminal_name(node.func)
+        f = self.func
+        if t in ("int", "float", "bool", "complex") and node.args:
+            if self._tainted(node.args[0]):
+                self.findings.append(_finding(
+                    "host-op-on-tracer", f, node,
+                    f"{t}() on a value flowing from traced parameter(s) "
+                    f"of {f.qualname} ({_chain(f)}); forcing a Python "
+                    "scalar inside traced code blocks on device sync or "
+                    "raises TracerConversionError — keep it a jnp value "
+                    "or move the readback outside the traced body",
+                ))
+        elif t == "item" and isinstance(node.func, ast.Attribute):
+            if self._tainted(node.func.value):
+                self.findings.append(_finding(
+                    "host-op-on-tracer", f, node,
+                    f".item() on a value flowing from traced parameter(s) "
+                    f"of {f.qualname} ({_chain(f)}); device->host readback "
+                    "inside traced code — return the value instead",
+                ))
+        elif t == "range" and node.args:
+            a0 = node.args[0]
+            if (isinstance(a0, ast.Call) and terminal_name(a0.func) == "len"
+                    and a0.args and self._tainted(a0.args[0])):
+                self.findings.append(_finding(
+                    "host-op-on-tracer", f, node,
+                    f"range(len(...)) over a traced parameter of "
+                    f"{f.qualname} ({_chain(f)}): the Python loop unrolls "
+                    "one program copy per element at trace time — use "
+                    "lax.scan / lax.fori_loop",
+                ))
+
+
+def _strip_static(expr: ast.AST):
+    """Return the expr for taint walking, or None when the whole expr is a
+    static-metadata access. Names under ``.shape``-like attributes and
+    inside ``len(...)`` do not carry tracers at runtime."""
+
+    class _T(ast.NodeTransformer):
+        def visit_Attribute(self, node):
+            if node.attr in STATIC_ATTRS:
+                return ast.copy_location(ast.Constant(value=None), node)
+            return self.generic_visit(node)
+
+        def visit_Call(self, node):
+            if terminal_name(node.func) == "len":
+                return ast.copy_location(ast.Constant(value=None), node)
+            return self.generic_visit(node)
+
+    return _T().visit(copy.deepcopy(expr))
+
+
+def check_host_op_on_tracer(project: Project) -> list[Finding]:
+    """``int()``/``float()``/``.item()``/``range(len())`` on values that
+    flow from the parameters of a traced function: a host scalar readback
+    (or a trace-time unroll) hiding inside device code. Static metadata
+    (``x.shape[0]``, ``x.ndim``, ``len(x)`` alone) is exempt."""
+    out = []
+    for f in project.funcs:
+        if not f.traced or f.is_module or not f.taint_params:
+            continue
+        out.extend(_TaintWalk(f).run())
+    return out
+
+
+# -- rule 5: per-call-logging-in-jit -----------------------------------------
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _is_logger_receiver(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        return terminal_name(expr.func) in ("get_logger", "getLogger",
+                                            "getChild")
+    t = terminal_name(expr)
+    if t is None:
+        return False
+    tl = t.lower()
+    return tl in ("warnings",) or "log" in tl
+
+
+def check_per_call_logging_in_jit(project: Project) -> list[Finding]:
+    """Logging calls inside traced bodies run once per TRACE, not once per
+    step — they look like per-batch telemetry and silently aren't, and
+    each retrace re-emits them. Use the one-shot ``info_once`` idiom for
+    trace-time signals, or ``jax.debug.print``/``jax.debug.callback`` for
+    genuine in-program output."""
+    out = []
+    for f in project.funcs:
+        if not f.traced or f.is_module:
+            continue
+        if f.name and f.name.endswith("once"):
+            continue  # the one-shot idiom's own implementation
+        for node in iter_owned(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal_name(node.func)
+            if isinstance(node.func, ast.Name) and t == "print":
+                out.append(_finding(
+                    "per-call-logging-in-jit", f, node,
+                    f"print() inside traced code ({_chain(f)}) runs at "
+                    "trace time, not per step; use jax.debug.print for "
+                    "in-program output or info_once for one-shot signals",
+                ))
+            elif (isinstance(node.func, ast.Attribute)
+                  and t in _LOG_METHODS
+                  and _is_logger_receiver(node.func.value)):
+                out.append(_finding(
+                    "per-call-logging-in-jit", f, node,
+                    f"logger .{t}() inside traced code ({_chain(f)}) fires "
+                    "once per trace and again on every retrace — use the "
+                    "one-shot info_once idiom (utils/trace.py) or "
+                    "jax.debug.callback",
+                ))
+    return out
+
+
+# -- rule 6: export-doc-drift -------------------------------------------------
+
+def _module_all(tree: ast.Module) -> list[tuple[str, ast.AST]]:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        return [
+                            (e.value, e) for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        ]
+    return []
+
+
+def check_export_doc_drift(project: Project) -> list[Finding]:
+    """Names in a top-level package ``__init__.py.__all__`` missing from
+    ``docs/API.md`` — the generated index (``scripts/gen_api_md.py``) went
+    stale. Applies to any analyzed ``__init__.py`` whose grandparent
+    directory carries ``docs/API.md`` (i.e. the package root)."""
+    out = []
+    for src in project.files:
+        if os.path.basename(src.path) != "__init__.py":
+            continue
+        pkg_dir = os.path.dirname(os.path.abspath(src.path))
+        api_md = os.path.join(os.path.dirname(pkg_dir), "docs", "API.md")
+        if not os.path.isfile(api_md):
+            continue
+        exports = _module_all(src.tree)
+        if not exports:
+            continue
+        try:
+            with open(api_md, encoding="utf-8") as fh:
+                documented = set(re.findall(r"`([^`\n]+)`", fh.read()))
+        except OSError:
+            continue
+        rel_md = os.path.relpath(api_md)
+        for name, node in exports:
+            if name not in documented:
+                out.append(_finding(
+                    "export-doc-drift", src.path, node,
+                    f"__all__ export {name!r} is missing from {rel_md}; "
+                    "regenerate it (JAX_PLATFORMS=cpu python "
+                    "scripts/gen_api_md.py)",
+                ))
+    return out
+
+
+RULES = {
+    "env-at-trace": check_env_at_trace,
+    "axis-name-consistency": check_axis_name_consistency,
+    "cond-branch-parity": check_cond_branch_parity,
+    "host-op-on-tracer": check_host_op_on_tracer,
+    "per-call-logging-in-jit": check_per_call_logging_in_jit,
+    "export-doc-drift": check_export_doc_drift,
+}
+
+# names valid in suppressions but emitted by the runner itself
+META_RULES = ("bad-suppression", "parse-error")
+
+
+def rule_docs() -> dict[str, str]:
+    return {name: (fn.__doc__ or "").strip() for name, fn in RULES.items()}

@@ -1,0 +1,225 @@
+"""graftlint runner: file collection, suppressions, rule orchestration.
+
+Suppression syntax (reason REQUIRED — a suppression that does not say why
+is itself a finding, and cannot be suppressed):
+
+    x = os.environ.get("K")  # graftlint: disable=env-at-trace -- initial default only
+    # graftlint: disable=axis-name-consistency -- fixture exercises drift
+    psum(x, "data")
+
+A comment alone on its line covers the next line; a trailing comment
+covers its own line. Multiple rules: ``disable=rule-a,rule-b -- reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+from .analysis import SourceFile, analyze
+from .rules import Finding, META_RULES, RULES
+
+__all__ = ["LintResult", "lint_paths", "collect_files"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(.*?))?\s*$"
+)
+# function-level trace barrier: on (or directly above) a def line, declares
+# the function eager-only by contract; reason mandatory like suppressions
+_EAGER_RE = re.compile(r"#\s*graftlint:\s*eager(?:\s*--\s*(.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the comment sits on
+    target_line: int  # line whose findings it covers
+    rules: tuple[str, ...]
+    reason: str
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # active (unsuppressed), sorted
+    suppressed: list[Finding]
+    files: list[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def to_dict(self):
+        return {
+            "version": 1,
+            "files_analyzed": len(self.files),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "counts": _counts(self.findings),
+        }
+
+
+def _counts(findings):
+    out: dict[str, int] = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def collect_files(paths) -> list[str]:
+    """Expand files/directories into a sorted .py file list (skips hidden
+    dirs and __pycache__)."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        else:
+            raise FileNotFoundError(p)
+    seen = set()
+    uniq = []
+    for p in out:
+        key = os.path.abspath(p)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(p)
+    return uniq
+
+
+def _display_path(path: str) -> str:
+    rel = os.path.relpath(path)
+    return rel if not rel.startswith("..") else os.path.abspath(path)
+
+
+def _parse_suppressions(path: str, text: str):
+    """(suppressions, eager-pin lines, bad-suppression findings)."""
+    sups: list[Suppression] = []
+    eager: dict[int, str] = {}
+    bad: list[Finding] = []
+    known = set(RULES) | set(META_RULES)
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        comments = [(t.start[0], t.start[1], t.string)
+                    for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups, eager, bad
+    lines = text.splitlines()
+    for lineno, col, comment in comments:
+        em = _EAGER_RE.search(comment)
+        if em:
+            reason = (em.group(1) or "").strip()
+            if not reason:
+                bad.append(Finding(
+                    "bad-suppression", path, lineno, col,
+                    "eager pin without a reason; every graftlint "
+                    "annotation must say WHY: "
+                    "'# graftlint: eager -- <reason>'"))
+                continue
+            own_line = lines[lineno - 1] if lineno <= len(lines) else ""
+            standalone = own_line[:col].strip() == ""
+            eager[lineno + 1 if standalone else lineno] = reason
+            continue
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            # only directive-looking comments (marker followed by a colon)
+            # are checked; prose that merely mentions graftlint is fine
+            if "graftlint" + ":" in comment:
+                bad.append(Finding(
+                    "bad-suppression", path, lineno, col,
+                    "malformed graftlint comment; expected '# graftlint: "
+                    "disable=<rule>[,<rule>] -- <reason>' or "
+                    "'# graftlint: eager -- <reason>'"))
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        unknown = [r for r in rules if r not in known]
+        if unknown:
+            bad.append(Finding(
+                "bad-suppression", path, lineno, col,
+                f"unknown rule(s) {unknown} in suppression; known rules: "
+                f"{sorted(RULES)}"))
+            continue
+        if not reason:
+            bad.append(Finding(
+                "bad-suppression", path, lineno, col,
+                "suppression without a reason; every graftlint suppression "
+                "must say WHY: '# graftlint: disable=<rule> -- <reason>'"))
+            continue
+        own_line = lines[lineno - 1] if lineno <= len(lines) else ""
+        standalone = own_line[:col].strip() == ""
+        target = lineno + 1 if standalone else lineno
+        sups.append(Suppression(lineno, target, rules, reason))
+    return sups, eager, bad
+
+
+def lint_paths(paths, select=None, ignore=None) -> LintResult:
+    """Run graftlint over files/directories. ``select``/``ignore`` are
+    iterables of rule names (select wins; both default to all rules)."""
+    file_paths = collect_files(paths)
+    sources: list[SourceFile] = []
+    findings: list[Finding] = []
+    suppressions: dict[str, list[Suppression]] = {}
+    for path in file_paths:
+        display = _display_path(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                text = fh.read()
+            tree = ast.parse(text, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                "parse-error", display,
+                getattr(e, "lineno", 1) or 1, 0,
+                f"cannot analyze: {type(e).__name__}: {e}"))
+            continue
+        sups, eager, bad = _parse_suppressions(display, text)
+        sources.append(SourceFile(path=display, text=text, tree=tree,
+                                  eager_lines=eager))
+        suppressions[display] = sups
+        findings.extend(bad)
+
+    project = analyze(sources)
+    active_rules = dict(RULES)
+    if select:
+        wanted = set(select)
+        unknown = wanted - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        active_rules = {k: v for k, v in RULES.items() if k in wanted}
+    if ignore:
+        unknown = set(ignore) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        active_rules = {k: v for k, v in active_rules.items()
+                        if k not in set(ignore)}
+    for check in active_rules.values():
+        findings.extend(check(project))
+
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in findings:
+        hit = None
+        if f.rule not in META_RULES:  # meta findings cannot be suppressed
+            for s in suppressions.get(f.path, []):
+                if f.line == s.target_line and f.rule in s.rules:
+                    hit = s
+                    break
+        if hit is not None:
+            f.suppressed = True
+            suppressed.append(f)
+        else:
+            active.append(f)
+    active.sort(key=Finding.sort_key)
+    suppressed.sort(key=Finding.sort_key)
+    return LintResult(findings=active, suppressed=suppressed,
+                      files=[s.path for s in sources])

@@ -43,6 +43,10 @@ _enabled: bool | None = None  # None = consult env var
 def trace_enabled() -> bool:
     if _enabled is not None:
         return _enabled
+    # env is only the initial default — enable_trace()/disable_trace() are
+    # the live switches, and an in-trace read only gates the trace-time
+    # profiler annotation (no runtime behavior depends on it)
+    # graftlint: disable=env-at-trace -- initial default; enable_trace() is the live switch
     return os.environ.get(_TRACE_ENV, "0") not in ("", "0", "false", "False")
 
 
@@ -111,6 +115,9 @@ def get_logger(child: str | None = None) -> logging.Logger:
     """
     logger = logging.getLogger("quiver_tpu")
     if not logger.handlers:
+        # handler bootstrap runs at most once (guarded by logger.handlers);
+        # the level is process-lifetime config, not a live switch
+        # graftlint: disable=env-at-trace -- one-shot handler bootstrap, not a live switch
         level = os.environ.get("QUIVER_LOG_LEVEL")
         if level:
             h = logging.StreamHandler()

@@ -10,6 +10,7 @@ Run after adding/renaming exports:
 import importlib
 import inspect
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -45,12 +46,17 @@ SECTIONS = [
     ("quiver_tpu.utils.checkpoint", "Orbax checkpointing"),
     ("quiver_tpu.utils.trace", "Tracing/profiling scopes"),
     ("quiver_tpu.datasets", "Dataset loaders + planted graphs"),
+    ("quiver_tpu.tools.lint",
+     "graftlint static analyzer (trace-safety rules)"),
 ]
 
 
 def first_line(obj) -> str:
     doc = inspect.getdoc(obj) or ""
     line = doc.splitlines()[0].strip() if doc else ""
+    # flax dataclass reprs embed object addresses — strip them so regens
+    # are deterministic and diffs stay reviewable
+    line = re.sub(r" object at 0x[0-9a-fA-F]+", " object", line)
     return line.replace("|", "\\|")
 
 

@@ -50,6 +50,10 @@ def mark(msg):
 # two hand-maintained 24-entry tables WILL drift). The two non-scoreboard
 # jobs (acceptance, sweep) are defined in EXTRA_JOBS.
 ORDER = [
+    # graftlint gate FIRST: a trace-safety/collective-consistency
+    # regression fails the session before any chip-window time is burned
+    # on benchmarks whose numbers a broken invariant would poison
+    ("lint", 120),
     ("primitives", 600),
     ("sampler-hbm", 1800),
     ("feature-replicate", 1200),
@@ -83,6 +87,10 @@ EXTRA_JOBS = {
     "acceptance": ("examples.train_sage",
                    ["--dataset", "planted:50000", "--epochs", "3"]),
     "sweep": ("benchmarks.sweep_sampler", ["--stream", "64"]),
+    # absolute paths: the runner's cwd is not guaranteed to be the repo
+    "lint": ("quiver_tpu.tools.lint",
+             [os.path.join(REPO, d)
+              for d in ("quiver_tpu", "scripts", "benchmarks")]),
 }
 
 
@@ -104,8 +112,12 @@ def job_table():
                          f"{sorted(unordered)}")
     return [(k, by_key[k][0], list(by_key[k][1]), b) for k, b in ORDER]
 
-# jobs whose records feed the scoreboard table (acceptance/sweep log-only)
-TABLE_EXCLUDE = {"acceptance", "sweep"}
+# jobs whose records feed the scoreboard table (acceptance/sweep/lint
+# log-only)
+TABLE_EXCLUDE = {"acceptance", "sweep", "lint"}
+
+# jobs that emit no {"metric": ...} records; success = clean exit alone
+LOG_ONLY_JOBS = {"acceptance", "lint"}
 
 
 class JobTimeout(Exception):
@@ -290,13 +302,20 @@ def main():
 
         recs = _harvest(tee.buf.getvalue())
         dt = time.time() - t0
-        # acceptance is the only truly log-only job; sweep swallows
-        # per-config errors and can return empty — keep it retryable then
-        if not err and (recs or key == "acceptance"):
+        # acceptance/lint are log-only jobs; sweep swallows per-config
+        # errors and can return empty — keep it retryable then
+        if not err and (recs or key in LOG_ONLY_JOBS):
             state["done"].append(key)
             save_state(args.state, state)
         mark(f"DONE {key}: {len(recs)} records in {dt:.0f}s"
              + (f" (error: {str(err)[:160]})" if err else ""))
+        if key == "lint" and err:
+            # fail FAST: a lint regression means some trace/collective
+            # invariant broke — benchmark numbers measured on top of it
+            # are not evidence; fix the tree, then rerun the session
+            mark(f"LINT GATE FAILED ({str(err)[:120]}); aborting session "
+                 "before burning bench budget")
+            return 5
         if key not in TABLE_EXCLUDE:
             job_result = {"key": key, "note": notes.get(key, ""),
                           "records": recs, "error": err,

@@ -1,0 +1,28 @@
+"""NEGATIVE fixture: axis names through the shared constants only."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.parallel.mesh import shard_map
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_gather(mesh):
+    def body(table, ids):
+        part = jnp.where(ids[:, None] >= 0, table[ids], 0)
+        total = jax.lax.psum(part, FEATURE_AXIS)
+        my = jax.lax.axis_index(FEATURE_AXIS)
+        return total, my
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(FEATURE_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+
+
+def worker_count(mesh):
+    return mesh.shape[DATA_AXIS] * mesh.shape[FEATURE_AXIS]

@@ -1,0 +1,30 @@
+"""POSITIVE fixture: hardcoded and drifted axis-name literals."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.parallel.mesh import shard_map
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+def make_gather(mesh):
+    def body(table, ids):
+        part = jnp.where(ids[:, None] >= 0, table[ids], 0)
+        # literal matches a declared axis but bypasses the constant
+        total = jax.lax.psum(part, "feature")  # LINT: hardcoded
+        # literal matches NO declared axis — string drift
+        my = jax.lax.axis_index("features")  # LINT: unknown axis
+        return total, my
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P("feature", None), P(DATA_AXIS)),  # LINT: hardcoded
+        out_specs=(P(DATA_AXIS), P()),
+    )
+
+
+def worker_count(mesh):
+    return mesh.shape["data"]  # LINT: hardcoded shape key

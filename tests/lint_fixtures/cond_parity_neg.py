@@ -1,0 +1,29 @@
+"""NEGATIVE fixture: cond branches with matching return structure."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def routed_with_fallback(ids, table, overflow):
+    def _fallback(args):
+        rows = table[jnp.clip(args, 0, table.shape[0] - 1)]
+        count = jnp.sum((args >= 0).astype(jnp.int32))
+        return rows, count
+
+    def _clean(args):
+        rows = jnp.zeros((args.shape[0], table.shape[1]), table.dtype)
+        return rows, jnp.int32(0)
+
+    return lax.cond(overflow > 0, _fallback, _clean, ids)
+
+
+@jax.jit
+def step(ids, table, overflow):
+    # lambdas with matching scalar returns are fine too
+    return lax.cond(
+        overflow > 0,
+        lambda x: x + 1,
+        lambda x: x - 1,
+        jnp.sum(table[ids]),
+    )

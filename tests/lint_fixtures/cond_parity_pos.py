@@ -1,0 +1,23 @@
+"""POSITIVE fixture: the psum-fallback cond pattern with drifted branch
+structure — one branch returns (rows, count), the other bare rows."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def routed_with_fallback(ids, table, overflow):
+    def _fallback(args):
+        rows = table[jnp.clip(args, 0, table.shape[0] - 1)]
+        count = jnp.sum((args >= 0).astype(jnp.int32))
+        return rows, count  # arity 2
+
+    def _clean(args):
+        return jnp.zeros((args.shape[0], table.shape[1]), table.dtype)
+
+    return lax.cond(overflow > 0, _fallback, _clean, ids)  # LINT: parity
+
+
+@jax.jit
+def step(ids, table, overflow):
+    return routed_with_fallback(ids, table, overflow)

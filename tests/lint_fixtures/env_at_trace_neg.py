@@ -1,0 +1,36 @@
+"""NEGATIVE fixture: the sanctioned resolve-once idiom (the PR 3 fix).
+
+The env read sits behind a module-global ``is None`` guard, so it runs
+once per process — a barrier for the traced-reachability walk."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_counts_strategy = None
+
+
+def resolve_counts_strategy() -> str:
+    global _counts_strategy
+    if _counts_strategy is None:
+        _counts_strategy = os.environ.get("QUIVER_COUNTS", "scan")
+    return _counts_strategy
+
+
+def occurrence_counts(ids, valid, n: int):
+    how = resolve_counts_strategy()
+    if how == "scan":
+        sv = jnp.sort(jnp.where(valid, ids, n))
+        edges = jnp.searchsorted(sv, jnp.arange(n + 1, dtype=ids.dtype))
+        return (edges[1:] - edges[:-1]).astype(jnp.float32)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32), jnp.where(valid, ids, n),
+        num_segments=n + 1,
+    )[:n]
+
+
+@jax.jit
+def model_step(ids, valid):
+    deg = occurrence_counts(ids, valid, 64)
+    return deg / jnp.maximum(deg.sum(), 1.0)

@@ -1,0 +1,29 @@
+"""POSITIVE fixture: the pre-PR-3 ``models/layers.py`` QUIVER_COUNTS bug.
+
+``occurrence_counts`` reads the env var on every call and is called from a
+jitted model body, so the "switch" silently freezes at first trace. This
+file is parsed by graftlint's self-tests, never imported."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def occurrence_counts(ids, valid, n: int):
+    # the bug: an env read that executes at trace time but looks live
+    how = os.environ.get("QUIVER_COUNTS", "scan")  # LINT: env-at-trace
+    if how == "scan":
+        sv = jnp.sort(jnp.where(valid, ids, n))
+        edges = jnp.searchsorted(sv, jnp.arange(n + 1, dtype=ids.dtype))
+        return (edges[1:] - edges[:-1]).astype(jnp.float32)
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32), jnp.where(valid, ids, n),
+        num_segments=n + 1,
+    )[:n]
+
+
+@jax.jit
+def model_step(ids, valid):
+    deg = occurrence_counts(ids, valid, 64)
+    return deg / jnp.maximum(deg.sum(), 1.0)

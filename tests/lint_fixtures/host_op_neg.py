@@ -1,0 +1,24 @@
+"""NEGATIVE fixture: static-metadata host math is fine in traced code."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_math(x, y):
+    n = int(x.shape[0])  # static: shapes are Python ints at trace time
+    k = float(x.ndim + y.ndim)
+    width = len(y)  # len of a traced array is its static leading dim
+    return jnp.broadcast_to(jnp.float32(k), (n,))[:width]
+
+
+def plan_cap(length, num_shards: int = 1, alpha: float = 2.0):
+    # EAGER planning helper (never reached from a trace entry here):
+    # host ints on config values are exactly what eager code should do
+    return max(1, min(int(alpha * length) // num_shards, int(length)))
+
+
+@jax.jit
+def static_slice(x, y):
+    cap = min(int(x.shape[0]), int(y.shape[0]))  # static shape math
+    return x[:cap] + y[:cap]

@@ -1,0 +1,24 @@
+"""POSITIVE fixture: host scalar ops on traced values."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_readback(x, y):
+    thresh = int(x[0])  # LINT: host-op-on-tracer
+    total = float(jnp.sum(y))  # LINT: host-op-on-tracer
+    return jnp.where(y > thresh, y, total)
+
+
+@jax.jit
+def bad_unroll(xs):
+    acc = jnp.zeros((), xs.dtype)
+    for i in range(len(xs)):  # LINT: host-op-on-tracer (unroll)
+        acc = acc + xs[i]
+    return acc
+
+
+@jax.jit
+def bad_item(x):
+    return x.item()  # LINT: host-op-on-tracer

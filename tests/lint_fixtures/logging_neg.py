@@ -1,0 +1,28 @@
+"""NEGATIVE fixture: the sanctioned trace-time logging idioms."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+_ONCE = set()
+
+
+def info_once(key, msg, *args):
+    if key in _ONCE:
+        return
+    _ONCE.add(key)
+    logging.getLogger("fixture").info(msg, *args)
+
+
+@jax.jit
+def quiet_step(x):
+    info_once("step-traced", "step traced at width %d", x.shape[0])
+    jax.debug.print("in-program value: {}", jnp.sum(x))
+    return x * 2
+
+
+def eager_driver(x):
+    # logging in EAGER code is fine — only traced bodies are flagged
+    logging.getLogger("fixture").info("running batch %s", x.shape)
+    return quiet_step(x)

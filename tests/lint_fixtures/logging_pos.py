@@ -1,0 +1,28 @@
+"""POSITIVE fixture: per-call logging inside traced bodies."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+
+def get_logger():
+    return logging.getLogger("fixture")
+
+
+@jax.jit
+def noisy_step(x):
+    print("tracing step")  # LINT: per-call-logging-in-jit
+    get_logger().info("gathered %d rows", x.shape[0])  # LINT
+    return x * 2
+
+
+def helper(x):
+    logger = logging.getLogger("fixture")
+    logger.warning("helper saw %s", x.shape)  # LINT (traced via call)
+    return x + 1
+
+
+@jax.jit
+def outer(x):
+    return helper(x)

@@ -129,3 +129,45 @@ def test_csr_save_load_minimal(tmp_path):
     np.testing.assert_array_equal(topo.indptr, back.indptr)
     np.testing.assert_array_equal(topo.indices, back.indices)
     assert back.edge_weight is None and back.feature_order is None
+
+
+def test_resolve_platform_strategy_edge_cases(monkeypatch):
+    """The shared env-override resolver behind every strategy knob
+    (QUIVER_COUNTS/QUIVER_DEDUP/QUIVER_INFER_AGG...): graftlint's
+    env-at-trace rule points users at this helper, so its contract is
+    pinned here — empty/whitespace fall through to the platform default,
+    values are case/whitespace-normalized, and a typo'd FORCE raises with
+    an actionable message instead of silently measuring the default."""
+    import pytest
+
+    from quiver_tpu.core.config import resolve_platform_strategy
+
+    choices = ("scan", "scatter")
+
+    def resolve():
+        return resolve_platform_strategy(
+            "QUIVER_TEST_STRAT", choices, tpu_default="scan",
+            other_default="scatter",
+        )
+
+    # unset / empty / whitespace-only -> platform default (cpu here)
+    monkeypatch.delenv("QUIVER_TEST_STRAT", raising=False)
+    assert resolve() == "scatter"
+    monkeypatch.setenv("QUIVER_TEST_STRAT", "")
+    assert resolve() == "scatter"
+    monkeypatch.setenv("QUIVER_TEST_STRAT", "   ")
+    assert resolve() == "scatter"
+
+    # case and surrounding whitespace are normalized, not rejected
+    monkeypatch.setenv("QUIVER_TEST_STRAT", "  SCAN  ")
+    assert resolve() == "scan"
+    monkeypatch.setenv("QUIVER_TEST_STRAT", "Scatter")
+    assert resolve() == "scatter"
+
+    # a typo'd force must raise, naming the var, the value, and the menu
+    monkeypatch.setenv("QUIVER_TEST_STRAT", "scann")
+    with pytest.raises(ValueError) as ei:
+        resolve()
+    msg = str(ei.value)
+    assert "QUIVER_TEST_STRAT" in msg and "scann" in msg
+    assert "scan" in msg and "scatter" in msg

@@ -10,7 +10,11 @@ from quiver_tpu.feature import feature as F
 
 @pytest.fixture(autouse=True)
 def fresh_election(tmp_path, monkeypatch):
+    # the election AND its env knobs are resolved once per process
+    # (env-before-first-use); tests reset all three caches to re-resolve
     monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setattr(F, "_ELECTION_CACHE_PATH", None)
+    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
     monkeypatch.setenv("QUIVER_ELECTION_CACHE",
                        str(tmp_path / "election.json"))
     monkeypatch.delenv("QUIVER_GATHER_KERNEL", raising=False)
@@ -31,6 +35,7 @@ def test_election_picks_measured_winner(fresh_election, monkeypatch):
     assert F._GATHER_ELECTION["how"] == "measured"
     # and the loser would have won with the numbers flipped
     monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setattr(F, "_ELECTION_CACHE_PATH", None)
     monkeypatch.setenv("QUIVER_ELECTION_CACHE",
                        str(fresh_election.parent / "election2.json"))
     monkeypatch.setattr(
@@ -69,6 +74,30 @@ def test_election_disk_cache_roundtrip(fresh_election, monkeypatch):
     assert F._elect_gather_kernel() == "xla"
 
 
+def test_env_knobs_pinned_at_first_use(fresh_election, monkeypatch):
+    """QUIVER_GATHER_KERNEL / QUIVER_ELECTION_CACHE resolve ONCE per
+    process: flipping them after the first use is inert without a cache
+    reset — the env-before-first-use contract graftlint's env-at-trace
+    rule enforces repo-wide (chip-window forcing must precede the first
+    gather)."""
+    monkeypatch.setenv("QUIVER_GATHER_KERNEL", "xla")
+    assert F._forced_gather_kernel() == "xla"
+    first_path = F._election_cache_path()
+    assert first_path == str(fresh_election)
+    # post-first-use flips are inert...
+    monkeypatch.setenv("QUIVER_GATHER_KERNEL", "pallas")
+    monkeypatch.setenv("QUIVER_ELECTION_CACHE",
+                       str(fresh_election.parent / "other.json"))
+    assert F._forced_gather_kernel() == "xla"
+    assert F._election_cache_path() == first_path
+    # ...including through the election itself
+    assert F._elect_gather_kernel() == "xla"
+    assert F._GATHER_ELECTION["how"] == "env override"
+    # a cache reset (= a fresh process) re-reads the env
+    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
+    assert F._forced_gather_kernel() == "pallas"
+
+
 def test_election_env_override_and_failsafes(fresh_election, monkeypatch):
     monkeypatch.setenv("QUIVER_GATHER_KERNEL", "xla")
     assert F._elect_gather_kernel() == "xla"
@@ -76,6 +105,7 @@ def test_election_env_override_and_failsafes(fresh_election, monkeypatch):
 
     # failed pallas smoke short-circuits to xla without measuring
     monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
     monkeypatch.delenv("QUIVER_GATHER_KERNEL")
     monkeypatch.setattr(F, "_pallas_gather_usable", lambda: False)
     assert F._elect_gather_kernel() == "xla"

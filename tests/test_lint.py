@@ -1,0 +1,249 @@
+"""graftlint self-tests: one positive + one negative fixture per rule,
+suppression semantics, CLI contract, and the repo-wide clean gate.
+
+The fixtures under ``tests/lint_fixtures/`` are PARSED, never imported —
+graftlint is pure-ast. The positive env-at-trace fixture reproduces the
+pre-PR-3 ``models/layers.py`` QUIVER_COUNTS pattern verbatim in miniature
+(acceptance criterion: the shipped bug class is demonstrably caught)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from quiver_tpu.tools.lint import RULES, lint_paths, main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+REPO = os.path.dirname(HERE)
+
+
+def fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+# -- per-rule fixtures (positive must fire, negative must stay clean) -------
+
+def test_env_at_trace_fixtures():
+    """The QUIVER_COUNTS bug class: env read inside a function called from
+    a jitted model body fires; the resolve-once idiom does not."""
+    pos = lint_paths([fx("env_at_trace_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "env-at-trace"]
+    assert len(hits) == 1
+    assert "os.environ.get" in hits[0].message
+    assert "occurrence_counts" in hits[0].message  # the traced chain names
+
+    neg = lint_paths([fx("env_at_trace_neg.py")])
+    assert "env-at-trace" not in rules_hit(neg)
+
+
+def test_axis_name_consistency_fixtures():
+    pos = lint_paths([fx("axis_name_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "axis-name-consistency"]
+    # psum("feature"), axis_index("features"), P("feature", ...),
+    # mesh.shape["data"]
+    assert len(hits) == 4
+    unknown = [f for f in hits if "matches no declared mesh axis" in f.message]
+    assert len(unknown) == 1 and "'features'" in unknown[0].message
+
+    neg = lint_paths([fx("axis_name_neg.py")])
+    assert "axis-name-consistency" not in rules_hit(neg)
+
+
+def test_cond_branch_parity_fixtures():
+    pos = lint_paths([fx("cond_parity_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "cond-branch-parity"]
+    assert len(hits) == 1
+    assert "mismatched structures" in hits[0].message
+
+    neg = lint_paths([fx("cond_parity_neg.py")])
+    assert "cond-branch-parity" not in rules_hit(neg)
+
+
+def test_host_op_on_tracer_fixtures():
+    pos = lint_paths([fx("host_op_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "host-op-on-tracer"]
+    # int(x[0]), float(sum), range(len(xs)), x.item()
+    assert len(hits) == 4
+    assert any("unrolls" in f.message for f in hits)
+    assert any(".item()" in f.message for f in hits)
+
+    neg = lint_paths([fx("host_op_neg.py")])
+    assert "host-op-on-tracer" not in rules_hit(neg)
+
+
+def test_per_call_logging_fixtures():
+    pos = lint_paths([fx("logging_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "per-call-logging-in-jit"]
+    # print(), get_logger().info, logger.warning (traced via call graph)
+    assert len(hits) == 3
+
+    neg = lint_paths([fx("logging_neg.py")])
+    assert "per-call-logging-in-jit" not in rules_hit(neg)
+
+
+def _mini_pkg(tmp_path, exports, documented):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir(parents=True)
+    body = "\n".join(f"{n} = None" for n in exports)
+    (pkg / "__init__.py").write_text(
+        f"{body}\n__all__ = {list(exports)!r}\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    rows = "\n".join(f"| `{n}` | doc |" for n in documented)
+    (docs / "API.md").write_text(f"# API index\n\n{rows}\n")
+    return pkg / "__init__.py"
+
+
+def test_export_doc_drift_fixtures(tmp_path):
+    init = _mini_pkg(tmp_path, ["alpha", "beta", "gamma"], ["alpha", "beta"])
+    pos = lint_paths([str(init)])
+    hits = [f for f in pos.findings if f.rule == "export-doc-drift"]
+    assert len(hits) == 1 and "'gamma'" in hits[0].message
+
+    init2 = _mini_pkg(tmp_path / "ok", ["alpha", "beta"], ["alpha", "beta"])
+    neg = lint_paths([str(init2)])
+    assert "export-doc-drift" not in rules_hit(neg)
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_silences(tmp_path):
+    src = textwrap.dedent("""\
+        import os
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            # graftlint: disable=env-at-trace -- fixture: frozen by design
+            flag = os.environ.get("FLAG", "0")
+            return x if flag == "0" else -x
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    res = lint_paths([str(p)])
+    assert not res.findings
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].rule == "env-at-trace"
+    assert res.exit_code == 0
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = textwrap.dedent("""\
+        import os
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            flag = os.environ.get("FLAG")  # graftlint: disable=env-at-trace
+            return x if flag else -x
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    res = lint_paths([str(p)])
+    rules = [f.rule for f in res.findings]
+    # the reasonless suppression is rejected AND the original finding stands
+    assert "bad-suppression" in rules
+    assert "env-at-trace" in rules
+    assert res.exit_code == 1
+
+
+def test_suppression_unknown_rule_is_a_finding(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("# graftlint: disable=not-a-rule -- whatever\nx = 1\n")
+    res = lint_paths([str(p)])
+    assert [f.rule for f in res.findings] == ["bad-suppression"]
+    assert "unknown rule" in res.findings[0].message
+
+
+def test_eager_pin_requires_reason(tmp_path):
+    src = textwrap.dedent("""\
+        import os
+        import jax
+
+
+        # graftlint: eager
+        def tuner(store):
+            return os.environ.get("K")
+
+
+        @jax.jit
+        def step(x, store):
+            tuner(store)
+            return x
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    res = lint_paths([str(p)])
+    rules = [f.rule for f in res.findings]
+    # reasonless pin rejected -> pin inactive -> env finding stands too
+    assert "bad-suppression" in rules and "env-at-trace" in rules
+    # with a reason, the pin is a trace barrier
+    p.write_text(src.replace("# graftlint: eager",
+                             "# graftlint: eager -- eager-only tuner"))
+    res = lint_paths([str(p)])
+    assert not res.findings
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    res = lint_paths([str(p)])
+    assert [f.rule for f in res.findings] == ["parse-error"]
+    assert res.exit_code == 1
+
+
+# -- CLI contract ------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == 1 and out["findings"] == []
+
+    assert main([fx("host_op_pos.py"), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["counts"]["host-op-on-tracer"] == 4
+    assert {f["rule"] for f in out["findings"]} == {"host-op-on-tracer"}
+
+    # usage errors are exit 2, distinct from findings
+    assert main([str(tmp_path / "missing_dir")]) == 2
+    assert main([str(clean), "--select", "bogus-rule"]) == 2
+
+
+def test_cli_select_and_ignore(capsys):
+    assert main([fx("host_op_pos.py"), "--select", "env-at-trace"]) == 0
+    capsys.readouterr()
+    assert main([fx("host_op_pos.py"), "--ignore", "host-op-on-tracer"]) == 0
+    capsys.readouterr()
+
+
+def test_list_rules_covers_registry(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+# -- the merge gate: the repo itself lints clean -----------------------------
+
+def test_repo_lints_clean():
+    """Acceptance criterion: ``python -m quiver_tpu.tools.lint quiver_tpu/
+    scripts/ benchmarks/`` exits 0 on the merged tree, with every
+    suppression carrying a reason (reasonless ones surface as
+    bad-suppression findings and fail this)."""
+    res = lint_paths([os.path.join(REPO, d)
+                      for d in ("quiver_tpu", "scripts", "benchmarks")])
+    assert res.findings == [], [
+        f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in res.findings
+    ]
+    # the tree exercises the suppression machinery for real
+    assert res.suppressed, "expected reasoned suppressions in the tree"

@@ -231,21 +231,36 @@ def test_complete_permutation_rejects_overlong():
 
 def test_resolve_dedup_platform_and_env(monkeypatch):
     """'auto' -> platform default (cpu->map here; tpu->scan by policy),
-    QUIVER_DEDUP overrides, explicit names pass through untouched."""
-    from quiver_tpu.ops.reindex import resolve_dedup
+    QUIVER_DEDUP overrides, explicit names pass through untouched. The
+    resolution is pinned ONCE per process (env-before-first-use — the
+    resolver runs inside traced sampler bodies, graftlint env-at-trace);
+    flipping the env mid-process requires a cache reset, which is exactly
+    what a live model can NOT do."""
+    from quiver_tpu.ops import reindex as R
 
+    def reset():
+        monkeypatch.setattr(R, "_forced_dedup", None)
+        monkeypatch.setattr(R, "_auto_dedup", None)
+
+    reset()
     monkeypatch.delenv("QUIVER_DEDUP", raising=False)
-    assert resolve_dedup("sort") == "sort"  # explicit passthrough
-    assert resolve_dedup("auto") == "map"  # tests pin JAX_PLATFORMS=cpu
+    assert R.resolve_dedup("sort") == "sort"  # explicit passthrough
+    assert R.resolve_dedup("auto") == "map"  # tests pin JAX_PLATFORMS=cpu
     monkeypatch.setenv("QUIVER_DEDUP", "scan")
-    assert resolve_dedup("auto") == "scan"
+    # without a reset the pinned resolution stays — env after first use is
+    # inert by contract
+    assert R.resolve_dedup("auto") == "map"
+    reset()
+    assert R.resolve_dedup("auto") == "scan"
     import pytest
 
+    reset()
     monkeypatch.setenv("QUIVER_DEDUP", "bogus")  # a typo'd FORCE must raise
     with pytest.raises(ValueError, match="QUIVER_DEDUP"):
-        resolve_dedup("auto")
+        R.resolve_dedup("auto")
     with pytest.raises(ValueError, match="dedup"):
-        resolve_dedup("hash")  # unknown explicit name rejected too
+        R.resolve_dedup("hash")  # unknown explicit name rejected too
+    reset()  # leave no pin for other tests
 
 
 def test_sampler_dedup_auto_resolves(monkeypatch):
