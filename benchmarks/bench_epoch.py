@@ -34,6 +34,7 @@ from benchmarks.common import (
     log,
     run_guarded,
     trimmed_mean,
+    write_metrics,
 )
 
 BASELINE_EPOCH_S = 11.1
@@ -161,14 +162,31 @@ def _body(args):
     )
     step = jax.jit(make_train_step(model, tx))
 
+    # graftscope stage attribution for the serial estimator: each stage is
+    # Timer-fed into a StepTimeline (block_until_ready sync points), so the
+    # run reports p50/p95/p99 per stage alongside the headline. The synced
+    # boundaries only move where the serial chain waits, not how long the
+    # whole iteration takes.
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.utils.trace import Timer
+
+    timeline = StepTimeline()
+
     def iteration(params, opt_state, key):
         seeds = rng.integers(0, n, args.batch)
-        out = sampler.sample(seeds)
-        x = feature[out.n_id]
+        with Timer("sample", quiet=True, registry=timeline):
+            out = sampler.sample(seeds)
+            jax.block_until_ready(out.n_id)
+        with Timer("gather", quiet=True, registry=timeline):
+            x = feature[out.n_id]
+            jax.block_until_ready(x)
         seed_ids = out.n_id[: args.batch]
         labels = labels_all[jnp.clip(seed_ids, 0)]
         mask = seed_ids >= 0
-        return step(params, opt_state, x, out.adjs, labels, mask, key)
+        with Timer("train_step", quiet=True, registry=timeline):
+            res = step(params, opt_state, x, out.adjs, labels, mask, key)
+            jax.block_until_ready(res[2])
+        return res
 
     # init + warmup (includes all compiles)
     out0 = sampler.sample(rng.integers(0, n, args.batch))
@@ -213,6 +231,7 @@ def _body(args):
             times.append(time.time() - t0)
 
         iter_s = trimmed_mean(times)
+        log("stage timeline (serial estimator):\n" + timeline.report())
     _emit_epoch(args, iter_s, loss, fused=False)
 
 
@@ -263,6 +282,11 @@ def _fused_measure(args, topo, feature, model, tx, labels_all, rng):
         )
         jax.block_until_ready(loss)
         times.append(time.time() - t0)
+    # graftscope: the fused step's telemetry (registry snapshots + the
+    # trainer's own stage timeline) — one-call summary in the log, the
+    # snapshots appended to the run's metrics.jsonl artifact
+    log(trainer.metrics_report())
+    write_metrics(trainer, lane="epoch-fused")
     return trimmed_mean(times), loss
 
 
@@ -336,6 +360,8 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
         measured="direct",
         loss=round(final_loss, 4),
     )
+    log(trainer.metrics_report())
+    write_metrics(trainer, lane="epoch-scan")
 
 
 def _emit_epoch(args, iter_s, loss, fused: bool):

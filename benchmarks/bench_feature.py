@@ -19,7 +19,14 @@ import time
 
 import numpy as np
 
-from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
+from benchmarks.common import (
+    base_parser,
+    build_graph,
+    emit,
+    log,
+    run_guarded,
+    write_metrics,
+)
 
 BASELINE_GBPS = 14.82
 
@@ -190,6 +197,10 @@ def _body(args):
         **_tier_hit_rates(store),
         **_routed_extras(store, routed_model),
     )
+    # metrics.jsonl artifact: the store's registry snapshots (tier hits)
+    # plus the hot tier's (routed overflow), attributed to this lane
+    write_metrics(store, getattr(store, "hot", None),
+                  lane="feature", policy=args.policy)
 
 
 def _routed_comm_model(args, store, h0: float = 0.0):
@@ -244,10 +255,17 @@ def _routed_comm_model(args, store, h0: float = 0.0):
 
 
 def _tier_hit_rates(store):
-    """Measured per-tier hit rates of the store's last eager gather
-    (ShardedFeature telemetry; {} for stores without it or before any
-    eager batch)."""
-    hits = getattr(store, "last_tier_hits", None)
+    """Measured per-tier hit rates of the store's last eager gather, read
+    from its graftscope registry (``feature.tier_hits``; {} for stores
+    without a registry or before any eager batch)."""
+    from quiver_tpu.obs.registry import TIER_HITS
+
+    reg = getattr(store, "metrics", None)
+    hits = reg.value(TIER_HITS) if hasattr(reg, "value") else None
+    if hits is None:
+        # duck-typed stores without a registry still surface the legacy
+        # attribute (kept as a thin view on real stores)
+        hits = getattr(store, "last_tier_hits", None)
     if hits is None:
         return {}
     h = np.asarray(hits).astype(np.float64)
@@ -263,12 +281,16 @@ def _tier_hit_rates(store):
 
 def _routed_extras(store, routed_model):
     """Ledger extras for a routed run: the comm model + the measured
-    fallback-served overflow count of the last gather."""
+    fallback-served overflow count of the last gather (from the hot
+    tier's graftscope registry, ``feature.routed_overflow``)."""
+    from quiver_tpu.obs.registry import ROUTED_OVERFLOW
+
     if routed_model is None:
         return {}
     extras = dict(routed_model)
-    ov = store.last_routed_overflow
-    extras["routed_overflow"] = 0 if ov is None else int(ov)
+    hot = getattr(store, "hot", None)
+    snap = None if hot is None else hot.metrics.snapshot(ROUTED_OVERFLOW)
+    extras["routed_overflow"] = 0 if snap is None else int(snap.numpy)
     return extras
 
 

@@ -26,6 +26,7 @@ from benchmarks.common import (
     run_guarded,
     sampler_roofline,
     stream_seps,
+    write_metrics,
 )
 
 
@@ -408,9 +409,13 @@ def _body_sharded(args):
     )
     caps = sampler._caps_for(seed_cap)
     model = _sharded_comm_model(sampler, seed_cap, caps)
-    ov = sampler.last_sample_overflow
+    # per-hop fallback overflow from the sampler's graftscope registry
+    # (``sample.hop_overflow``) instead of poking the legacy attribute
+    from quiver_tpu.obs.registry import SAMPLE_OVERFLOW
+
+    snap = sampler.metrics.snapshot(SAMPLE_OVERFLOW)
     sample_overflow = (
-        [int(v) for v in np.asarray(ov)] if ov is not None
+        [int(v) for v in snap.numpy] if snap is not None
         else [0] * len(sampler.sizes)
     )
     emit(
@@ -430,6 +435,7 @@ def _body_sharded(args):
         sample_overflow=sample_overflow,
         **model,
     )
+    write_metrics(sampler, lane="sampler-sharded")
 
 
 def _bench_round_up(x: int, m: int) -> int:

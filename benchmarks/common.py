@@ -725,6 +725,41 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr)
 
 
+def write_metrics(*sources, **extra) -> int:
+    """Persist graftscope registry snapshots to the run's metrics.jsonl
+    artifact (``QUIVER_METRICS_JSONL``; mega_session points it at its
+    output dir — unset, the call is a no-op).
+
+    ``sources``: objects carrying a ``.metrics`` registry (stores,
+    samplers, trainers), bare registries, or ``None`` (skipped). Record-
+    context fields (nodes, smoke, prng) and ``extra`` ride on every row so
+    the artifact lines are attributable to their workload. Best-effort —
+    telemetry persistence must never break a measurement run.
+    """
+    snaps = []
+    for src in sources:
+        if src is None:
+            continue
+        reg = getattr(src, "metrics", src)
+        get = getattr(reg, "snapshots", None)
+        if callable(get):
+            snaps.extend(get())
+    if not snaps:
+        return 0
+    fields = {k: v for k, v in _RECORD_CONTEXT.items()}
+    fields.update({k: v for k, v in extra.items() if v is not None})
+    try:
+        from benchmarks import ledger
+
+        n = ledger.append_metrics(snaps, extra=fields)
+        if n:
+            log(f"metrics: {n} snapshot rows -> {ledger.metrics_jsonl_path()}")
+        return n
+    except Exception as e:  # noqa: BLE001 — artifact write must not cost a run
+        log(f"metrics artifact write failed: {type(e).__name__}: {e}")
+        return 0
+
+
 def emit(
     metric: str,
     value: float,

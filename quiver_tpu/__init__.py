@@ -28,6 +28,12 @@ from .sampling.saint import (
     SAINTRandomWalkSampler,
     saint_subgraph,
 )
+from .obs import (
+    MetricSnapshot,
+    MetricsRegistry,
+    StepTimeline,
+    profile_epoch,
+)
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.debug import show_tensor_info, tensor_info
@@ -82,6 +88,10 @@ __all__ = [
     "trace_scope",
     "enable_trace",
     "get_logger",
+    "MetricsRegistry",
+    "MetricSnapshot",
+    "StepTimeline",
+    "profile_epoch",
 ]
 
 __version__ = "0.1.0"

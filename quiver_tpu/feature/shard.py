@@ -55,6 +55,7 @@ from .feature import (
 )
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
+from ..obs.registry import ROUTED_OVERFLOW, TIER_HITS, MetricsRegistry
 from ..ops.sample import staged_gather
 from ..parallel.routing import BucketRoute
 from ..utils.trace import get_logger, info_once
@@ -90,14 +91,31 @@ class ShardedTensor(KernelChoice):
         if routed_alpha <= 0:
             raise ValueError(f"routed_alpha must be > 0, got {routed_alpha}")
         self.routed_alpha = float(routed_alpha)
-        # device scalar from the last capped routed gather (None before
-        # any); read lazily — int() forces a sync, so consumers (the
-        # auto-tuner, benchmarks, trace metadata) pull it after the batch.
-        self.last_routed_overflow = None
+        # graftscope registry: the overflow count of the last capped routed
+        # gather lands here (``last_routed_overflow`` is a thin view). Read
+        # lazily — int() forces a sync, so consumers (the auto-tuner,
+        # benchmarks, exporters) pull it after the batch.
+        self.metrics = MetricsRegistry()
+        self.metrics.counter(
+            ROUTED_OVERFLOW, unit="lanes",
+            doc="fallback-served lanes of the last capped routed gather",
+        )
         self.table = None
         self.rows_per_shard = 0
         self.num_rows = 0
         self._gather_cache = {}
+
+    @property
+    def last_routed_overflow(self):
+        """Fallback-served lane count of the last eager capped routed
+        gather (device scalar; ``(steps,)`` after an epoch_scan write;
+        None before any). Thin view of the ``feature.routed_overflow``
+        registry metric — new consumers should read ``self.metrics``."""
+        return self.metrics.value(ROUTED_OVERFLOW)
+
+    @last_routed_overflow.setter
+    def last_routed_overflow(self, value):
+        self.metrics.set(ROUTED_OVERFLOW, value)
 
     def from_cpu_tensor(self, tensor: np.ndarray) -> "ShardedTensor":
         n, f = tensor.shape
@@ -439,11 +457,17 @@ class ShardedFeature(KernelChoice):
         self.rep_rows = 0
         self.hot_rows = 0
         self.shape = None
-        # per-tier hit counts [replicated, sharded, cold] of the last eager
-        # gather (device int32 (3,); None before any). Trainers overwrite it
-        # with their psum'd batch totals so the split tuner sees the fused
-        # path's traffic too.
-        self.last_tier_hits = None
+        # graftscope registry: per-tier hit counts [replicated, sharded,
+        # cold] of the last eager gather land here (``last_tier_hits`` is a
+        # thin view; device int32 (3,), None before any). Trainers
+        # overwrite it with their psum'd batch totals so the split tuner
+        # sees the fused path's traffic too.
+        self.metrics = MetricsRegistry()
+        self.metrics.gauge(
+            TIER_HITS, shape=(3,), unit="hits",
+            doc="per-tier feature hits [replicated, sharded, cold] of the "
+                "last gather",
+        )
         # host copy of the device region (rows [0, rep_rows + hot_rows) in
         # storage dtype) kept iff the L0/L1 boundary may move after
         # placement (auto_split or a nonzero replicate budget) — resplit
@@ -588,6 +612,17 @@ class ShardedFeature(KernelChoice):
             else ("none" if device_rows == n else "device"),
         )
         return self
+
+    @property
+    def last_tier_hits(self):
+        """Per-tier hit counts of the last eager gather (thin view of the
+        ``feature.tier_hits`` registry metric — new consumers should read
+        ``self.metrics``)."""
+        return self.metrics.value(TIER_HITS)
+
+    @last_tier_hits.setter
+    def last_tier_hits(self, value):
+        self.metrics.set(TIER_HITS, value)
 
     @property
     def cache_ratio(self) -> float:

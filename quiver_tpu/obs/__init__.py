@@ -1,0 +1,63 @@
+"""graftscope — unified in-program metrics, step timeline, and export.
+
+The observability layer the three hand-threaded ``last_*`` telemetry
+streams grew into (SURVEY §0/§5: sampling throughput and cache hit rates
+are the signals that drove the reference's design). One discipline, four
+pieces:
+
+* :class:`MetricsRegistry` / :class:`MetricsTape` — named counters/gauges
+  that ride a single metrics pytree through ``shard_map``/``lax.scan``/
+  cond-gated fallbacks, psum'd once per step, landing as typed
+  :class:`MetricSnapshot` objects (``registry.py``);
+* :class:`StepTimeline` — host-side per-stage wall clock with streaming
+  p50/p95/p99 (``timeline.py``);
+* JSONL + Prometheus-style exporters, both parse-back round-trippable
+  (``export.py``);
+* :func:`profile_epoch` — ``jax.profiler`` capture bracketing with the
+  same stage names on the device timeline (``profile.py``).
+
+``DistributedTrainer.metrics_report()`` is the one-call summary over all
+of it.
+"""
+
+from .export import (
+    from_prometheus,
+    prometheus_name,
+    read_jsonl,
+    snapshot_from_dict,
+    snapshot_to_dict,
+    to_prometheus,
+    write_jsonl,
+)
+from .profile import profile_epoch
+from .registry import (
+    ROUTED_OVERFLOW,
+    SAMPLE_OVERFLOW,
+    TIER_HITS,
+    MetricSnapshot,
+    MetricSpec,
+    MetricsRegistry,
+    MetricsTape,
+)
+from .timeline import P2Quantile, StageStats, StepTimeline
+
+__all__ = [
+    "MetricSpec",
+    "MetricSnapshot",
+    "MetricsRegistry",
+    "MetricsTape",
+    "ROUTED_OVERFLOW",
+    "TIER_HITS",
+    "SAMPLE_OVERFLOW",
+    "P2Quantile",
+    "StageStats",
+    "StepTimeline",
+    "snapshot_to_dict",
+    "snapshot_from_dict",
+    "write_jsonl",
+    "read_jsonl",
+    "to_prometheus",
+    "from_prometheus",
+    "prometheus_name",
+    "profile_epoch",
+]

@@ -1,0 +1,205 @@
+"""Structured export of :class:`MetricSnapshot` streams.
+
+Two formats, both round-trippable (tests/test_obs.py proves
+``parse(emit(snaps))`` reproduces the values, including epoch_scan-shaped
+``(steps, k)`` metrics):
+
+* **JSON lines** — one self-describing object per snapshot
+  (``{"name", "kind", "steps", "shape", "dtype", "value", ...}``) for
+  long-run artifacts (``metrics.jsonl``) and offline analysis;
+* **Prometheus-style text exposition** — ``# HELP``/``# TYPE`` plus one
+  sample per element (vector metrics carry an ``idx="i,j"`` label) for
+  scraping live runs. A ``# QUIVER`` metadata comment per metric (ignored
+  by scrapers — ``#`` lines that are not HELP/TYPE are comments) carries
+  the original dotted name, dtype, steps and shape so the exposition
+  parses back losslessly.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+
+import numpy as np
+
+from .registry import MetricSnapshot
+
+__all__ = [
+    "snapshot_to_dict",
+    "snapshot_from_dict",
+    "write_jsonl",
+    "read_jsonl",
+    "to_prometheus",
+    "from_prometheus",
+    "prometheus_name",
+]
+
+
+# -- JSON lines ---------------------------------------------------------------
+
+def snapshot_to_dict(snap: MetricSnapshot) -> dict:
+    arr = snap.numpy
+    return {
+        "name": snap.name,
+        "kind": snap.kind,
+        "steps": snap.steps,
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.name,
+        "value": arr.tolist(),
+        "unit": snap.unit,
+        "doc": snap.doc,
+    }
+
+
+def snapshot_from_dict(d: dict) -> MetricSnapshot:
+    arr = np.asarray(d["value"], dtype=np.dtype(d["dtype"]))
+    arr = arr.reshape(tuple(d["shape"]))
+    return MetricSnapshot(
+        d["name"], d["kind"], arr, d.get("steps"),
+        d.get("unit", ""), d.get("doc", ""),
+    )
+
+
+def write_jsonl(snapshots, path_or_file, extra: dict | None = None) -> int:
+    """Append one JSON line per snapshot; ``extra`` fields (run identity —
+    job key, platform, timestamp) are merged into every line. Returns the
+    number of lines written."""
+    rows = []
+    for snap in snapshots:
+        d = snapshot_to_dict(snap)
+        if extra:
+            d.update(extra)
+        rows.append(json.dumps(d))
+    if not rows:
+        return 0
+    if hasattr(path_or_file, "write"):
+        path_or_file.write("\n".join(rows) + "\n")
+    else:
+        with open(path_or_file, "a", encoding="utf-8") as fh:
+            fh.write("\n".join(rows) + "\n")
+    return len(rows)
+
+
+def read_jsonl(path_or_text) -> list[MetricSnapshot]:
+    """Parse a metrics.jsonl file (path, file object, or text) back into
+    snapshots; non-metric lines are skipped."""
+    if hasattr(path_or_text, "read"):
+        text = path_or_text.read()
+    elif "\n" in path_or_text or path_or_text.lstrip().startswith("{"):
+        text = path_or_text
+    else:
+        with open(path_or_text, encoding="utf-8") as fh:
+            text = fh.read()
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and {"name", "kind", "value"} <= d.keys():
+            out.append(snapshot_from_dict(d))
+    return out
+
+
+# -- Prometheus-style exposition ----------------------------------------------
+
+def prometheus_name(name: str) -> str:
+    """Dotted metric name -> a legal exposition metric name."""
+    return "quiver_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def to_prometheus(snapshots) -> str:
+    """Text exposition of the snapshots (one sample per array element)."""
+    out = io.StringIO()
+    for snap in snapshots:
+        arr = snap.numpy
+        pname = prometheus_name(snap.name)
+        shape = ",".join(str(s) for s in arr.shape)
+        out.write(
+            f"# QUIVER {pname} name={snap.name} kind={snap.kind} "
+            f"dtype={arr.dtype.name} steps={snap.steps} "
+            f"shape={shape or '-'}\n"
+        )
+        if snap.doc:
+            out.write(f"# HELP {pname} {snap.doc.splitlines()[0]}\n")
+        out.write(f"# TYPE {pname} {snap.kind}\n")
+        if arr.ndim == 0:
+            out.write(f"{pname} {_fmt(arr[()])}\n")
+        else:
+            for idx in np.ndindex(arr.shape):
+                lbl = ",".join(str(i) for i in idx)
+                out.write(f'{pname}{{idx="{lbl}"}} {_fmt(arr[idx])}\n')
+    return out.getvalue()
+
+
+def _fmt(v) -> str:
+    if np.issubdtype(np.asarray(v).dtype, np.integer):
+        return str(int(v))
+    return repr(float(v))
+
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{idx="(?P<idx>[0-9,]*)"\})?\s+(?P<val>\S+)$'
+)
+_META = re.compile(
+    r"^# QUIVER (?P<pname>\S+) name=(?P<name>\S+) kind=(?P<kind>\S+) "
+    r"dtype=(?P<dtype>\S+) steps=(?P<steps>\S+) shape=(?P<shape>\S+)$"
+)
+
+
+def from_prometheus(text: str) -> list[MetricSnapshot]:
+    """Parse an exposition produced by :func:`to_prometheus` back into
+    snapshots (the ``# QUIVER`` metadata lines make the round trip
+    lossless — dtype, steps axis, and shape are all recovered)."""
+    meta: dict[str, dict] = {}
+    samples: dict[str, dict[tuple, str]] = {}
+    order: list[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        m = _META.match(line)
+        if m:
+            d = m.groupdict()
+            meta[d["pname"]] = d
+            if d["pname"] not in order:
+                order.append(d["pname"])
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        pname = m.group("name")
+        idx = m.group("idx")
+        key = () if idx is None else tuple(
+            int(i) for i in idx.split(",") if i != ""
+        )
+        samples.setdefault(pname, {})[key] = m.group("val")
+        if pname not in order:
+            order.append(pname)
+    out = []
+    for pname in order:
+        vals = samples.get(pname, {})
+        md = meta.get(pname)
+        if md is None or not vals:
+            continue
+        dtype = np.dtype(md["dtype"])
+        shape = (
+            () if md["shape"] == "-"
+            else tuple(int(s) for s in md["shape"].split(","))
+        )
+        arr = np.zeros(shape, dtype)
+        for key, raw in vals.items():
+            v = int(raw) if np.issubdtype(dtype, np.integer) else float(raw)
+            arr[key] = v
+        steps = None if md["steps"] == "None" else int(md["steps"])
+        out.append(
+            MetricSnapshot(md["name"], md["kind"], arr, steps)
+        )
+    return out

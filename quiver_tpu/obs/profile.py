@@ -1,0 +1,45 @@
+"""Profiler bracketing for whole epochs.
+
+``profile_epoch(log_dir)`` wraps a training epoch in a ``jax.profiler``
+capture (TensorBoard/Perfetto format, the reference's stdtracer role) and
+force-enables ``trace_scope`` for its duration — so every
+``StepTimeline.stage(...)`` and ``trace_scope(...)`` inside the block lands
+as a named slice on BOTH the host track and the XLA device timeline, with
+the same stage names the host-side metrics report uses. The prior
+trace-enable state is restored on exit (a profiled epoch must not leave
+tracing globally on).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..utils import trace as _trace
+from ..utils.trace import trace_scope
+
+__all__ = ["profile_epoch"]
+
+
+@contextlib.contextmanager
+def profile_epoch(log_dir: str, name: str = "epoch"):
+    """Capture a device+host profile of the enclosed epoch.
+
+    >>> with profile_epoch("/tmp/prof"):
+    ...     params, opt_state, losses = trainer.epoch_scan(...)
+
+    opens in TensorBoard/Perfetto with the epoch bracketed under ``name``
+    and every inner stage annotated.
+    """
+    prev = _trace._enabled
+    _trace.enable_trace()
+    jax.profiler.start_trace(log_dir)
+    try:
+        with trace_scope(name):
+            yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _trace._enabled = prev

@@ -60,10 +60,16 @@ class BucketRoute:
       cap: per-destination bucket capacity. ``None`` or ``>= L`` means
         full-length buckets — the exact-safe uncapped mode; no fallback
         machinery is traced and :attr:`overflow` is a constant 0.
+      tape: optional ``obs.MetricsTape`` — the plan's :attr:`overflow`
+        count is fed to it as counter ``metric`` (graftscope: routing
+        telemetry rides the step's metrics pytree instead of inventing a
+        surfacing convention; the metric must be registered on the tape's
+        registry).
+      metric: tape counter name; defaults to ``obs.ROUTED_OVERFLOW``.
     """
 
     def __init__(self, ids, valid, owner, *, axis: str, num_shards: int,
-                 cap: int | None = None):
+                 cap: int | None = None, tape=None, metric: str | None = None):
         F = int(num_shards)
         L = int(ids.shape[0])
         if cap is None or int(cap) >= L:
@@ -118,6 +124,12 @@ class BucketRoute:
         # them. Plans live and die inside one traced body, so caching the
         # traced value is safe.
         self._recv_ids = None
+        if tape is not None:
+            from ..obs.registry import ROUTED_OVERFLOW
+
+            # the psum'd overflow is uniform across the axis group, so the
+            # tape value needs no further feature-axis reduction
+            tape.add(metric or ROUTED_OVERFLOW, self.overflow)
 
     # -- internals ----------------------------------------------------------
 

@@ -39,6 +39,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.config import parse_size_bytes
 from ..feature.feature import Feature
 from ..feature.shard import ShardedFeature
+from ..obs.registry import (
+    ROUTED_OVERFLOW,
+    SAMPLE_OVERFLOW,
+    TIER_HITS,
+    MetricsRegistry,
+)
+from ..obs.timeline import StepTimeline
 from ..utils.trace import info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..parallel.pipeline import Prefetcher
@@ -79,6 +86,7 @@ class DistributedTrainer:
         routed_alpha: float | None = 2.0,
         replicate_budget: int | str | None = None,
         auto_alpha: bool = False,
+        collect_metrics: bool = True,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -123,20 +131,47 @@ class DistributedTrainer:
         # sampler routing overflowed (fallback-served — exact, just extra
         # comm), alpha doubles (capped at F) and the step retraces.
         self.auto_alpha = bool(auto_alpha)
-        # device scalar(s): fallback-served lane count of the last step
-        # (or per-step vector of the last epoch_scan); 0 when the gather
-        # is psum-flavored or uncapped
-        self.last_routed_overflow = None
-        # sampling sibling: per-hop fallback-served lane counts of the
-        # topo-sharded sampler's last step (int32 (num_layers,) device
-        # vector, seeds-outward; (steps, num_layers) after epoch_scan;
-        # all-zero for replicated-topology samplers)
-        self.last_sample_overflow = None
-        # per-tier hit counts [replicated, sharded, cold] of the last
-        # step's feature gather, psum'd mesh-wide (int32 (3,) device
-        # vector; (steps, 3) after epoch_scan) — the measured hit
-        # distribution the eager split tuner consumes between batches
-        self.last_tier_hits = None
+        # graftscope (obs/): ONE registry serves every telemetry stream the
+        # step program produces. The traced body feeds a MetricsTape, the
+        # resulting metrics pytree rides the shard_map/scan outputs (psum'd
+        # once per step at each metric's declared axes), and step()/
+        # epoch_scan() land it as typed MetricSnapshots. The legacy
+        # ``last_*`` attributes below are thin views of the registry:
+        #   feature.routed_overflow — fallback-served lane count of the
+        #     step (scalar; (steps,) after epoch_scan; 0 when the gather
+        #     is psum-flavored or uncapped)
+        #   feature.tier_hits — per-tier hits [replicated, sharded, cold],
+        #     psum'd mesh-wide (int32 (3,); (steps, 3) after epoch_scan) —
+        #     what the eager split tuner consumes between batches
+        #   sample.hop_overflow — the topo-sharded sampler's per-hop
+        #     fallback lanes (int32 (num_layers,), seeds-outward;
+        #     (steps, num_layers) after epoch_scan; zeros for replicated
+        #     topologies)
+        # collect_metrics=False disables collection at the PROGRAM level:
+        # the compiled step carries zero metric values/collectives and the
+        # loss trajectory is bit-identical (tests/test_obs.py differential).
+        self.collect_metrics = bool(collect_metrics)
+        self.metrics = MetricsRegistry(enabled=self.collect_metrics)
+        self.metrics.counter(
+            ROUTED_OVERFLOW, unit="lanes",
+            doc="capped-bucket fallback-served lanes of the step's sharded "
+                "feature gather",
+        )
+        self.metrics.gauge(
+            TIER_HITS, shape=(3,), unit="hits",
+            doc="mesh-total per-tier feature hits "
+                "[replicated, sharded, cold]",
+        )
+        self.metrics.counter(
+            SAMPLE_OVERFLOW, shape=(len(tuple(sampler.sizes)),),
+            unit="lanes",
+            doc="per-hop fallback-served lanes of the topo-sharded "
+                "sampler (seeds-outward)",
+        )
+        # host-side stage timeline (streaming p50/p95/p99); step() and
+        # epoch_scan() time their eager dispatch, callers can add their own
+        # stages (or feed it via Timer(registry=trainer.timeline))
+        self.timeline = StepTimeline()
         # replicate_budget: L0 super-hot tier override. A value re-splits a
         # ShardedFeature's replicated/sharded boundary BEFORE the program
         # is built (needs the store's retained host region); on a plain
@@ -214,6 +249,70 @@ class DistributedTrainer:
         self._step = self._build()
         self._epoch_fn = self._build_epoch()
 
+    # -- telemetry views (API compatibility over the metrics registry) ------
+
+    @property
+    def last_routed_overflow(self):
+        """Thin view of registry metric ``feature.routed_overflow``."""
+        return self.metrics.value(ROUTED_OVERFLOW)
+
+    @last_routed_overflow.setter
+    def last_routed_overflow(self, value):
+        self.metrics.set(ROUTED_OVERFLOW, value)
+
+    @property
+    def last_tier_hits(self):
+        """Thin view of registry metric ``feature.tier_hits``."""
+        return self.metrics.value(TIER_HITS)
+
+    @last_tier_hits.setter
+    def last_tier_hits(self, value):
+        self.metrics.set(TIER_HITS, value)
+
+    @property
+    def last_sample_overflow(self):
+        """Thin view of registry metric ``sample.hop_overflow``."""
+        return self.metrics.value(SAMPLE_OVERFLOW)
+
+    @last_sample_overflow.setter
+    def last_sample_overflow(self, value):
+        self.metrics.set(SAMPLE_OVERFLOW, value)
+
+    def metrics_report(self) -> str:
+        """One-call text summary of the trainer's telemetry: every recorded
+        registry metric (totals + the most recent per-step value) plus the
+        host StepTimeline's streaming percentiles."""
+        lines = []
+        snaps = self.metrics.snapshots()
+        if snaps:
+            lines.append("metrics:")
+            for s in snaps:
+                arr = s.numpy
+                head = f"  {s.name} ({s.kind}"
+                if s.steps is not None:
+                    head += f", {s.steps} steps"
+                head += ")"
+                if s.kind == "counter":
+                    head += f": total={int(arr.sum())}"
+                    if s.steps is not None:
+                        head += f" last={np.asarray(s.last()).tolist()}"
+                else:
+                    head += f": last={np.asarray(s.last()).tolist()}"
+                    if s.steps is not None:
+                        head += f" total={arr.sum(axis=0).tolist()}"
+                lines.append(head)
+        else:
+            lines.append(
+                "metrics: (none recorded"
+                + ("" if self.collect_metrics else "; collect_metrics=False")
+                + ")"
+            )
+        lines.append("timeline:")
+        lines.extend(
+            "  " + ln for ln in self.timeline.report().splitlines()
+        )
+        return "\n".join(lines)
+
     # -- program ------------------------------------------------------------
 
     def _mesh_wide_host(self, arr):
@@ -274,6 +373,7 @@ class DistributedTrainer:
         routed = self.seed_sharding == "all"
         routed_alpha = self.routed_alpha
         topo_sharded = self.topo_sharded
+        metrics = self.metrics
         node_count = sampler.csr_topo.node_count
         rows_per_shard = (
             sampler.topo.rows_per_shard if topo_sharded else 0
@@ -386,23 +486,25 @@ class DistributedTrainer:
             axes = (DATA_AXIS, FEATURE_AXIS)
             grads = jax.lax.pmean(grads, axes)
             loss = jax.lax.pmean(loss, axes)
-            # feature-psum'd already inside routed_gather; the data-axis
-            # psum makes the batch total replicated mesh-wide
-            routed_ov = jax.lax.psum(routed_ov, DATA_AXIS)
-            # tier hits: under "all" every device holds distinct lanes, so
-            # the mesh-wide psum is the batch total; under "data" the
-            # feature-group members process the SAME lanes redundantly —
-            # summing them too would overcount each lane F times
-            tier_hits = jax.lax.psum(
-                tier_hits, axes if routed else DATA_AXIS
-            )
+            # graftscope: the step's telemetry rides ONE metrics pytree.
+            # Each metric declares its own mesh reduction (applied once by
+            # tape.finalize): the routed overflow and per-hop sample
+            # overflow are feature-psum'd inside the route already, so the
+            # data-axis psum makes them mesh-wide totals; tier hits under
+            # "all" are distinct lanes per device (mesh-wide psum = batch
+            # total) while under "data" the feature-group members process
+            # the SAME lanes redundantly — summing them too would overcount
+            # each lane F times. With collect_metrics=False the tape feeds
+            # nothing and the program carries zero metric collectives.
+            tape = metrics.tape()
+            tape.add(ROUTED_OVERFLOW, routed_ov, psum=DATA_AXIS)
+            tape.set(TIER_HITS, tier_hits,
+                     psum=axes if routed else DATA_AXIS)
             if topo_sharded:
-                # per-hop sampling overflow: feature-psum'd inside the
-                # route; the data-axis psum makes it the mesh-wide total
-                sample_ov = jax.lax.psum(sample_ov, DATA_AXIS)
+                tape.add(SAMPLE_OVERFLOW, sample_ov, psum=DATA_AXIS)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, routed_ov, tier_hits, sample_ov
+            return params, opt_state, loss, tape.finalize()
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
         parts_spec = (P(), hot_spec, P(), P(), P())
@@ -410,13 +512,18 @@ class DistributedTrainer:
             (P(FEATURE_AXIS, None), P(FEATURE_AXIS, None))
             if topo_sharded else P()
         )
+        # metric values come out replicated (psum'd at their declared axes)
+        metric_specs = (
+            {name: P() for name in metrics.names()}
+            if metrics.enabled else {}
+        )
         fn = shard_map(
             body,
             mesh=mesh,
             in_specs=(
                 P(), P(), topo_spec, parts_spec, self._seed_spec(), P(), P(),
             ),
-            out_specs=(P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), metric_specs),
             check_vma=False,
         )
         return jax.jit(fn)
@@ -499,24 +606,24 @@ class DistributedTrainer:
         the jit cache, so the program retraces on the new split).
         """
         feature = self.feature
-        if isinstance(feature, ShardedFeature) and feature.auto_split:
-            feature._maybe_auto_split()
-        self._maybe_grow_routed_alpha()
-        packed = self.shard_seeds(seeds)
-        packed = jax.device_put(
-            jnp.asarray(packed), NamedSharding(self.mesh, self._seed_spec())
-        )
-        params, opt_state, loss, routed_ov, tier_hits, sample_ov = self._step(
-            params, opt_state, self.topo, self._feature_parts(), packed,
-            labels, key
-        )
-        self.last_routed_overflow = routed_ov
-        self.last_tier_hits = tier_hits
-        self.last_sample_overflow = sample_ov
-        if isinstance(feature, ShardedFeature):
+        with self.timeline.stage("step"):
+            if isinstance(feature, ShardedFeature) and feature.auto_split:
+                feature._maybe_auto_split()
+            self._maybe_grow_routed_alpha()
+            packed = self.shard_seeds(seeds)
+            packed = jax.device_put(
+                jnp.asarray(packed),
+                NamedSharding(self.mesh, self._seed_spec()),
+            )
+            params, opt_state, loss, mtree = self._step(
+                params, opt_state, self.topo, self._feature_parts(), packed,
+                labels, key
+            )
+        self.metrics.record(mtree)
+        if mtree and isinstance(feature, ShardedFeature):
             # hand the batch totals to the store so its eager split tuner
             # sees the fused path's traffic too
-            feature.last_tier_hits = tier_hits
+            feature.last_tier_hits = mtree[TIER_HITS]
         return params, opt_state, loss
 
     def pack_epoch(self, train_idx: np.ndarray, seed=None, key=None):
@@ -553,15 +660,14 @@ class DistributedTrainer:
             def body(carry, xs):
                 p, o = carry
                 seeds, k = xs
-                p, o, loss, routed_ov, hits, sample_ov = step(
-                    p, o, topo, parts, seeds, labels, k
-                )
-                return (p, o), (loss, routed_ov, hits, sample_ov)
+                p, o, loss, mtree = step(p, o, topo, parts, seeds, labels, k)
+                return (p, o), (loss, mtree)
 
-            (p, o), (losses, routed_ovs, hits, sample_ovs) = jax.lax.scan(
+            (p, o), (losses, mtrees) = jax.lax.scan(
                 body, (params, opt_state), (seed_mat, keys)
             )
-            return p, o, losses, routed_ovs, hits, sample_ovs
+            # mtrees: each metric stacked to (steps,) + its per-step shape
+            return p, o, losses, mtrees
 
         return fn  # jit's shape-keyed cache handles distinct step counts
 
@@ -584,19 +690,17 @@ class DistributedTrainer:
         epoch (one compiled program); the eager tuner moves it between
         epochs.
         """
-        self._maybe_grow_routed_alpha()
-        packed = jax.device_put(
-            jnp.asarray(seed_mat),
-            NamedSharding(self.mesh, P(None, *self._seed_spec())),
-        )
-        (params, opt_state, losses, routed_ovs, tier_hits,
-         sample_ovs) = self._epoch_fn(
-            params, opt_state, self.topo, self._feature_parts(), packed,
-            labels, key
-        )
-        self.last_routed_overflow = routed_ovs
-        self.last_tier_hits = tier_hits
-        self.last_sample_overflow = sample_ovs
+        with self.timeline.stage("epoch_scan"):
+            self._maybe_grow_routed_alpha()
+            packed = jax.device_put(
+                jnp.asarray(seed_mat),
+                NamedSharding(self.mesh, P(None, *self._seed_spec())),
+            )
+            params, opt_state, losses, mtrees = self._epoch_fn(
+                params, opt_state, self.topo, self._feature_parts(), packed,
+                labels, key
+            )
+        self.metrics.record(mtrees)
         return params, opt_state, losses
 
     # graftlint: eager -- between-batch tuner on host numpy telemetry; the

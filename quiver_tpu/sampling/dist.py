@@ -49,6 +49,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.config import SampleMode
 from ..core.sharded_topology import ShardedTopology
 from ..core.topology import CSRTopo
+from ..obs.registry import SAMPLE_OVERFLOW, MetricsRegistry
 from ..ops.reindex import reindex_layer, resolve_dedup
 from ..ops.sample import rotate_offsets, stratified_offsets
 from ..parallel.mesh import FEATURE_AXIS, shard_map
@@ -289,9 +290,16 @@ class DistGraphSageSampler(GraphSageSampler):
         self.routed_alpha = (
             None if routed_alpha is None else float(routed_alpha)
         )
-        # per-hop fallback-served lane counts of the last eager sample
-        # (int32 (num_layers,) device vector; None before any)
-        self.last_sample_overflow = None
+        # graftscope registry: per-hop fallback-served lane counts of the
+        # last eager sample land here (``last_sample_overflow`` is a thin
+        # view; int32 (num_layers,) device vector, seeds-outward; None
+        # before any)
+        self.metrics = MetricsRegistry()
+        self.metrics.counter(
+            SAMPLE_OVERFLOW, shape=(len(tuple(sizes)),), unit="lanes",
+            doc="per-hop fallback-served lanes of the last distributed "
+                "sample (seeds-outward)",
+        )
         super().__init__(
             csr_topo, sizes, device=device, mode=mode,
             seed_capacity=seed_capacity, frontier_caps=frontier_caps,
@@ -299,6 +307,17 @@ class DistGraphSageSampler(GraphSageSampler):
             kernel=kernel, with_eid=with_eid, dedup=dedup,
         )
         self.topo_sharding = "mesh"
+
+    @property
+    def last_sample_overflow(self):
+        """Per-hop fallback-served lane counts of the last eager sample
+        (thin view of the ``sample.hop_overflow`` registry metric — new
+        consumers should read ``self.metrics``)."""
+        return self.metrics.value(SAMPLE_OVERFLOW)
+
+    @last_sample_overflow.setter
+    def last_sample_overflow(self, value):
+        self.metrics.set(SAMPLE_OVERFLOW, value)
 
     # -- topology placement (overrides the replicated upload) ---------------
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import sys
 import time
 
 import jax
@@ -32,6 +33,7 @@ __all__ = [
     "Timer",
     "get_logger",
     "info_once",
+    "reset_once",
     "start_trace",
     "stop_trace",
 ]
@@ -84,13 +86,22 @@ class Timer:
     ...     out = sampler.sample(seeds)
     prints ``[sample] 12.3 ms`` at scope exit (via the package logger) and
     leaves the duration in ``t.seconds``.
+
+    ``registry=`` feeds the measured duration to an aggregator with an
+    ``observe(name, seconds)`` method — an ``obs.StepTimeline`` (or a
+    ``MetricsRegistry`` adapter) — so existing ``Timer("sample", sync=...)``
+    call sites join the graftscope step timeline instead of only logging;
+    ``metric=`` overrides the stage name fed to it.
     """
 
-    def __init__(self, name: str, sync=None, quiet: bool = False):
+    def __init__(self, name: str, sync=None, quiet: bool = False,
+                 registry=None, metric: str | None = None):
         self.name = name
         self.seconds = 0.0
         self._sync = sync  # optional array/pytree to block_until_ready on exit
         self._quiet = quiet
+        self._registry = registry
+        self._metric = metric or name
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -102,6 +113,8 @@ class Timer:
         self.seconds = time.perf_counter() - self._t0
         if not self._quiet:
             get_logger().info("[%s] %.1f ms", self.name, self.seconds * 1e3)
+        if self._registry is not None:
+            self._registry.observe(self._metric, self.seconds)
         return False
 
 
@@ -120,13 +133,30 @@ def get_logger(child: str | None = None) -> logging.Logger:
         # graftlint: disable=env-at-trace -- one-shot handler bootstrap, not a live switch
         level = os.environ.get("QUIVER_LOG_LEVEL")
         if level:
-            h = logging.StreamHandler()
-            h.setFormatter(
-                logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
-            )
-            logger.addHandler(h)
-            logger.setLevel(level)
-            logger.propagate = False
+            try:
+                # validate BEFORE mutating the logger: a bogus level (e.g.
+                # QUIVER_LOG_LEVEL=bogus) must not crash the process at its
+                # first log call — fall back to the library-friendly
+                # NullHandler path with a one-line warning instead
+                logger.setLevel(level)
+            except ValueError:
+                # graftlint: disable=per-call-logging-in-jit -- one-shot handler bootstrap (guarded by logger.handlers), not a per-step path
+                print(
+                    f"quiver_tpu: ignoring invalid QUIVER_LOG_LEVEL="
+                    f"{level!r} (use DEBUG/INFO/WARNING/ERROR/CRITICAL "
+                    "or an int); logging stays at the library default",
+                    file=sys.stderr,
+                )
+                logger.addHandler(logging.NullHandler())
+            else:
+                h = logging.StreamHandler()
+                h.setFormatter(
+                    logging.Formatter(
+                        "%(asctime)s %(name)s %(levelname)s %(message)s"
+                    )
+                )
+                logger.addHandler(h)
+                logger.propagate = False
         else:
             logger.addHandler(logging.NullHandler())
     return logger.getChild(child) if child else logger
@@ -147,6 +177,16 @@ def info_once(key: str, msg: str, *args, child: str | None = None) -> None:
         return
     _ONCE_KEYS.add(key)
     get_logger(child).info(msg, *args)
+
+
+def reset_once() -> None:
+    """Clear :func:`info_once`'s once-per-process memory.
+
+    For test fixtures: without this, one-shot log state leaks across tests
+    in the same process and log-assertion tests become order-dependent
+    (the first test to trigger a key swallows it for every later test).
+    """
+    _ONCE_KEYS.clear()
 
 
 def start_trace(log_dir: str) -> None:

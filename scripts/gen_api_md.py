@@ -45,6 +45,8 @@ SECTIONS = [
     ("quiver_tpu.utils.reorder", "Degree-based feature reorder"),
     ("quiver_tpu.utils.checkpoint", "Orbax checkpointing"),
     ("quiver_tpu.utils.trace", "Tracing/profiling scopes"),
+    ("quiver_tpu.obs",
+     "graftscope — metrics registry, step timeline, exporters"),
     ("quiver_tpu.datasets", "Dataset loaders + planted graphs"),
     ("quiver_tpu.tools.lint",
      "graftlint static analyzer (trace-safety rules)"),

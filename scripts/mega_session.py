@@ -191,6 +191,13 @@ def main():
     from benchmarks.common import _enable_compilation_cache
 
     _enable_compilation_cache()
+    # graftscope artifact: every bench lane appends its registry snapshots
+    # (tier hits, routed/sample overflow) to ONE metrics.jsonl per run —
+    # durable telemetry evidence next to the scoreboard outputs. An
+    # explicit QUIVER_METRICS_JSONL (or empty, to disable) wins.
+    os.environ.setdefault(
+        "QUIVER_METRICS_JSONL", os.path.join(args.out, "metrics.jsonl")
+    )
 
     jobs = job_table()
     if args.only:

@@ -18,3 +18,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_info_once():
+    """Each test starts with a clean ``info_once`` memory — otherwise
+    one-shot log state leaks across tests in the same process and
+    log-assertion tests become order-dependent."""
+    from quiver_tpu.utils.trace import reset_once
+
+    reset_once()
+    yield

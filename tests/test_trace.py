@@ -78,6 +78,43 @@ def test_timer_logs(caplog):
     assert any("[scope]" in r.message for r in caplog.records)
 
 
+def test_get_logger_invalid_level_falls_back(monkeypatch, capsys):
+    """QUIVER_LOG_LEVEL=bogus must not crash the process at the first log
+    call — the bootstrap falls back to the NullHandler path with a one-line
+    stderr warning."""
+    root = logging.getLogger("quiver_tpu")
+    saved = root.handlers[:]
+    saved_propagate, saved_level = root.propagate, root.level
+    try:
+        root.handlers = []
+        monkeypatch.setenv("QUIVER_LOG_LEVEL", "bogus")
+        logger = trace.get_logger()
+        logger.info("still works")  # must not raise
+        assert any(
+            isinstance(h, logging.NullHandler) for h in root.handlers
+        )
+        err = capsys.readouterr().err
+        assert "QUIVER_LOG_LEVEL" in err and "bogus" in err
+    finally:
+        root.handlers = saved
+        root.propagate, root.level = saved_propagate, saved_level
+
+
+def test_info_once_reset(caplog):
+    logger = trace.get_logger()
+    with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+        logger.propagate = True
+        try:
+            trace.info_once("k-reset-test", "once msg")
+            trace.info_once("k-reset-test", "once msg")
+            assert sum("once msg" in r.message for r in caplog.records) == 1
+            trace.reset_once()  # the test-fixture hook (conftest autouse)
+            trace.info_once("k-reset-test", "once msg")
+            assert sum("once msg" in r.message for r in caplog.records) == 2
+        finally:
+            logger.propagate = False
+
+
 def test_get_logger_singleton_handler():
     a, b = trace.get_logger(), trace.get_logger()
     root = logging.getLogger("quiver_tpu")
