@@ -1,0 +1,237 @@
+"""Chaos lane: FaultPlan drills over a tiny epoch — the resilience layer's
+evidence job (mega_session ``chaos`` stage, log-only).
+
+Three deterministic drills, each asserting the property the resilience
+layer guarantees (quiver_tpu/resilience/):
+
+* **guard**: a NaN-poisoned batch inside the fused step leaves params
+  bit-unchanged and the skip counter reads exactly 1;
+* **retry**: seeded transient sampler faults are absorbed by the
+  Prefetcher's bounded backoff and the delivered stream is bit-identical
+  to a fault-free run;
+* **preempt/resume**: a simulated kill mid-epoch, then resume() — the
+  remaining loss trajectory is bit-identical to the uninterrupted run.
+
+Any drill failure raises (the session marks the job failed); success
+prints one ``CHAOS <drill> OK`` line per drill.
+
+    python -m benchmarks.chaos --smoke
+"""
+
+import argparse
+import tempfile
+
+import numpy as np
+
+from benchmarks import common
+
+
+def _build_graph(nodes: int, feature_dim: int, seed: int):
+    from quiver_tpu import CSRTopo
+
+    rng = np.random.default_rng(seed)
+    topo = CSRTopo(
+        edge_index=rng.integers(0, nodes, size=(2, 10 * nodes)).astype(
+            np.int64
+        )
+    )
+    feat = rng.normal(size=(nodes, feature_dim)).astype(np.float32)
+    labels = rng.integers(0, 4, nodes).astype(np.int32)
+    return topo, feat, labels
+
+
+def _build_trainer(topo, feat, local_batch, plan=None, guard=False,
+                   checkpoint_dir=None, checkpoint_every=0):
+    import optax
+
+    from quiver_tpu import Feature, GraphSageSampler
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    mesh = make_mesh()  # data = all devices, feature = 1
+    sampler = GraphSageSampler(
+        topo, [5, 5], seed=3, seed_capacity=local_batch
+    )
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    kw = {}
+    if checkpoint_dir is not None:
+        kw = dict(checkpoint_dir=checkpoint_dir,
+                  checkpoint_every=checkpoint_every)
+    return DistributedTrainer(
+        mesh, sampler, feature, model, optax.sgd(1e-2),
+        local_batch=local_batch, nonfinite_guard=guard, fault_plan=plan,
+        **kw
+    )
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def drill_guard(topo, feat, labels, local_batch, seed):
+    """NaN batch -> cond-skipped update, params preserved, counter = 1."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import FaultPlan
+    from quiver_tpu.obs.registry import GUARD_SKIPPED
+
+    plan = FaultPlan(nan_feature_steps=(1,), nan_rows=8)
+    trainer = _build_trainer(topo, feat, local_batch, plan=plan, guard=True)
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    lab = jnp.asarray(labels)
+    rng = np.random.default_rng(seed)
+    for step in range(3):
+        p_before = params
+        params, opt, loss = trainer.step(
+            params, opt, rng.integers(0, topo.node_count,
+                                      trainer.global_batch),
+            lab, jax.random.PRNGKey(step),
+        )
+        if step == 1:
+            assert not np.isfinite(float(loss)), "poisoned loss was finite"
+            assert _tree_equal(params, p_before), \
+                "poisoned step mutated params"
+            skipped = int(np.asarray(trainer.metrics.value(GUARD_SKIPPED)))
+            assert skipped == 1, f"skip counter {skipped} != 1"
+        else:
+            assert np.isfinite(float(loss)), f"clean step {step} loss NaN"
+    common.write_metrics(trainer, drill="chaos-guard")
+    common.log("CHAOS guard OK (poisoned step skipped, params preserved)")
+
+
+def drill_retry(topo, steps, local_batch, seed):
+    """Seeded transient sampler faults -> retried, stream bit-identical."""
+    from quiver_tpu import FaultPlan, GraphSageSampler
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.parallel.pipeline import Prefetcher
+
+    plan = FaultPlan.chaos(
+        seed=seed, steps=steps, transient_p=0.4, max_transient=2
+    )
+    if not plan.sampler_faults:
+        # a sparse draw must not turn the drill into a no-op
+        import dataclasses
+
+        plan = dataclasses.replace(plan, sampler_faults={1: 2})
+    seeds = [
+        np.random.default_rng(seed + i).integers(
+            0, topo.node_count, local_batch
+        )
+        for i in range(steps)
+    ]
+    oracle = GraphSageSampler(topo, [5, 5], seed=3,
+                              seed_capacity=local_batch)
+    clean = [oracle.sample(s) for s in seeds]
+    faulty = plan.wrap_sampler(
+        GraphSageSampler(topo, [5, 5], seed=3, seed_capacity=local_batch)
+    )
+    timeline = StepTimeline()
+    pf = Prefetcher(faulty, None, depth=2, retries=3, backoff=1e-3,
+                    timeline=timeline)
+    batches = list(pf.run(seeds))
+    assert len(batches) == steps, f"{len(batches)}/{steps} delivered"
+    planned = sum(plan.sampler_faults.values())
+    assert pf.retries_total == planned, \
+        f"retries {pf.retries_total} != planned {planned}"
+    for c, b in zip(clean, batches):
+        assert np.array_equal(np.asarray(c.n_id), np.asarray(b.out.n_id)), \
+            "recovered stream diverged from the fault-free oracle"
+    common.log(
+        f"CHAOS retry OK ({planned} transient faults absorbed, stream "
+        "bit-identical)"
+    )
+
+
+def drill_preempt_resume(topo, feat, labels, local_batch, seed):
+    """Kill at a planned step, resume, compare the trajectory bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import FaultPlan, Preemption
+
+    lab = jnp.asarray(labels)
+    idx = np.random.default_rng(seed).integers(
+        0, topo.node_count, 6 * local_batch * jax.device_count()
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer_a = _build_trainer(
+            topo, feat, local_batch, checkpoint_dir=f"{tmp}/a",
+            checkpoint_every=2,
+        )
+        seed_mat = trainer_a.pack_epoch(idx, seed=0)
+        key = jax.random.PRNGKey(7)
+        pa, oa = trainer_a.init(jax.random.PRNGKey(0))
+        pa, oa, losses_a = trainer_a.epoch_scan(pa, oa, seed_mat, lab, key)
+        losses_a = np.asarray(losses_a)
+
+        trainer_b = _build_trainer(
+            topo, feat, local_batch, checkpoint_dir=f"{tmp}/b",
+            checkpoint_every=2, plan=FaultPlan(preempt_at_step=3),
+        )
+        p0, o0 = trainer_b.init(jax.random.PRNGKey(0))
+        preempted = False
+        try:
+            trainer_b.epoch_scan(p0, o0, seed_mat, lab, key)
+        except Preemption:
+            preempted = True
+        assert preempted, "FaultPlan preemption never fired"
+        pr, orr, key_r, step, epoch = trainer_b.resume(p0, o0)
+        assert step == 2, f"resumed at step {step}, expected 2"
+        pr, orr, losses_r = trainer_b.epoch_scan(
+            pr, orr, seed_mat, lab, key_r, epoch=epoch, start_step=step
+        )
+        losses_r = np.asarray(losses_r)
+        assert np.array_equal(
+            losses_r.view(np.uint32), losses_a[step:].view(np.uint32)
+        ), "resumed loss trajectory diverged"
+        assert _tree_equal(pa, pr), "resumed final params diverged"
+        trainer_a.checkpointer.close()
+        trainer_b.checkpointer.close()
+    common.log(
+        f"CHAOS preempt/resume OK (killed at step 3, resumed at {step}, "
+        f"{losses_r.shape[0]} remaining steps bit-identical)"
+    )
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=2000)
+    p.add_argument("--feature-dim", type=int, default=16)
+    p.add_argument("--local-batch", type=int, default=16)
+    p.add_argument("--retry-steps", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="shrink the drills further (rehearsal mode)")
+    args = p.parse_args()
+    if args.smoke:
+        args.nodes = min(args.nodes, 800)
+        args.retry_steps = min(args.retry_steps, 4)
+
+    common.init_backend()
+    topo, feat, labels = _build_graph(
+        args.nodes, args.feature_dim, args.seed
+    )
+
+    def body():
+        drill_guard(topo, feat, labels, args.local_batch, args.seed)
+        drill_retry(topo, args.retry_steps, args.local_batch, args.seed)
+        drill_preempt_resume(
+            topo, feat, labels, args.local_batch, args.seed
+        )
+        common.log("CHAOS all drills passed")
+        return 0
+
+    return common.run_guarded(body, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,6 +34,7 @@ from .obs import (
     StepTimeline,
     profile_epoch,
 )
+from .resilience import FaultPlan, Preemption, TransientFault
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.debug import show_tensor_info, tensor_info
@@ -92,6 +93,9 @@ __all__ = [
     "MetricSnapshot",
     "StepTimeline",
     "profile_epoch",
+    "FaultPlan",
+    "Preemption",
+    "TransientFault",
 ]
 
 __version__ = "0.1.0"

@@ -31,6 +31,8 @@ from .export import (
 )
 from .profile import profile_epoch
 from .registry import (
+    GUARD_NONFINITE,
+    GUARD_SKIPPED,
     ROUTED_OVERFLOW,
     SAMPLE_OVERFLOW,
     TIER_HITS,
@@ -49,6 +51,8 @@ __all__ = [
     "ROUTED_OVERFLOW",
     "TIER_HITS",
     "SAMPLE_OVERFLOW",
+    "GUARD_SKIPPED",
+    "GUARD_NONFINITE",
     "P2Quantile",
     "StageStats",
     "StepTimeline",

@@ -48,6 +48,8 @@ __all__ = [
     "ROUTED_OVERFLOW",
     "TIER_HITS",
     "SAMPLE_OVERFLOW",
+    "GUARD_SKIPPED",
+    "GUARD_NONFINITE",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -56,6 +58,10 @@ __all__ = [
 ROUTED_OVERFLOW = "feature.routed_overflow"
 TIER_HITS = "feature.tier_hits"
 SAMPLE_OVERFLOW = "sample.hop_overflow"
+# resilience layer: steps cond-skipped by the non-finite guard, and the
+# mesh-total count of non-finite loss/grad values it detected
+GUARD_SKIPPED = "resilience.skipped_steps"
+GUARD_NONFINITE = "resilience.nonfinite_grads"
 
 _KINDS = ("counter", "gauge")
 

@@ -13,15 +13,28 @@ replaces UVA's "kernel reads host RAM while computing" trick.
 
 Single worker thread => sampler PRNG call order stays deterministic: the
 prefetched stream is bit-identical to the sequential loop (tested).
+
+Resilience (the reference fails the whole ``mp.spawn`` run on one worker
+exception): transient host-side failures — a sampler/feature/transform
+raising on a preempted host or flaky storage — are retried with bounded
+exponential backoff + deterministic jitter (``retries=``/``backoff=``),
+and a batch still failing after retries exhaust either surfaces (default)
+or is skipped-and-counted (``skip_policy="skip"``) so one poisoned batch
+cannot end a long run. Per-batch retry/skip telemetry rides any
+StepTimeline-compatible registry passed as ``timeline=``.
 """
 
 from __future__ import annotations
 
 import collections
 import concurrent.futures
+import random
+import time
 from typing import Callable, Iterable, Iterator, NamedTuple
 
 __all__ = ["Batch", "Prefetcher"]
+
+_SKIP_POLICIES = ("raise", "skip")
 
 
 class Batch(NamedTuple):
@@ -30,6 +43,13 @@ class Batch(NamedTuple):
     seeds: object  # the raw seed array this batch was built from
     out: object  # SampleOutput (n_id, batch_size, adjs, ...)
     x: object  # gathered feature rows for out.n_id
+
+
+class _Skipped(NamedTuple):
+    """Worker-side marker for a batch dropped under skip_policy="skip"."""
+
+    seeds: object
+    error: BaseException
 
 
 class Prefetcher:
@@ -43,6 +63,29 @@ class Prefetcher:
         double buffering).
       transform: optional host callback (seeds, out, x) -> Batch-like, run
         on the worker thread (e.g. label lookup).
+      retries: max re-dispatches per batch after a raising
+        sample/gather/transform (0 = fail fast, the pre-resilience
+        behavior). Retries re-enter the whole dispatch, so a sampler that
+        failed BEFORE drawing keeps its PRNG call order — the recovered
+        stream is bit-identical to a fault-free one.
+      backoff: first retry delay in seconds; doubles per attempt, capped
+        at ``backoff_cap``.
+      backoff_cap: upper bound on a single backoff sleep.
+      jitter: fractional random pad on each sleep (delay *= 1 + U[0,1) *
+        jitter), drawn from a PRNG seeded with ``retry_seed`` — runs are
+        reproducible, but co-scheduled workers desynchronize.
+      skip_policy: what to do when retries exhaust — ``"raise"`` surfaces
+        the exception at the batch's yield (default); ``"skip"`` drops the
+        poisoned batch, counts it (``skips_total``), and keeps streaming.
+      timeline: optional StepTimeline-compatible registry
+        (``observe(name, seconds)``) fed per-batch stages:
+        ``prefetch.dispatch`` (successful dispatch wall time),
+        ``prefetch.retry_wait`` (each backoff sleep), ``prefetch.skip``
+        (each dropped batch).
+      retry_seed: seed for the jitter PRNG.
+
+    ``retries_total`` / ``skips_total`` count across the prefetcher's
+    lifetime (single worker thread — no synchronization needed).
 
     >>> for batch in Prefetcher(sampler, feature).run(seed_stream):
     ...     params, opt, loss = step(params, opt, batch.x, batch.out.adjs, ...)
@@ -54,13 +97,45 @@ class Prefetcher:
         feature=None,
         depth: int = 2,
         transform: Callable | None = None,
+        retries: int = 0,
+        backoff: float = 0.05,
+        backoff_cap: float = 2.0,
+        jitter: float = 0.5,
+        skip_policy: str = "raise",
+        timeline=None,
+        retry_seed: int = 0,
     ):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if retries < 0:
+            raise ValueError(f"retries must be >= 0, got {retries}")
+        if backoff < 0 or backoff_cap < 0 or jitter < 0:
+            raise ValueError(
+                f"backoff/backoff_cap/jitter must be >= 0, got "
+                f"{backoff}/{backoff_cap}/{jitter}"
+            )
+        if skip_policy not in _SKIP_POLICIES:
+            raise ValueError(
+                f"skip_policy must be one of {_SKIP_POLICIES}, "
+                f"got {skip_policy!r}"
+            )
         self.sampler = sampler
         self.feature = feature
         self.depth = depth
         self.transform = transform
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.jitter = float(jitter)
+        self.skip_policy = skip_policy
+        self.timeline = timeline
+        self._jitter_rng = random.Random(retry_seed)
+        self.retries_total = 0
+        self.skips_total = 0
+
+    def _observe(self, stage: str, seconds: float) -> None:
+        if self.timeline is not None:
+            self.timeline.observe(stage, seconds)
 
     def _dispatch(self, seeds) -> Batch:
         out = self.sampler.sample(seeds)
@@ -69,10 +144,48 @@ class Prefetcher:
             return self.transform(seeds, out, x)
         return Batch(seeds, out, x)
 
+    def _dispatch_resilient(self, seeds):
+        """One batch with bounded retry; runs on the worker thread."""
+        attempt = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = self._dispatch(seeds)
+            except Exception as e:  # noqa: BLE001 — bounded retry, then
+                if attempt >= self.retries:  # surface or skip per policy
+                    if self.skip_policy == "skip":
+                        self.skips_total += 1
+                        self._observe("prefetch.skip", 0.0)
+                        from ..utils.trace import get_logger
+
+                        get_logger().warning(
+                            "prefetch: batch dropped after %d retr%s "
+                            "(skip_policy='skip'): %s: %s",
+                            attempt, "y" if attempt == 1 else "ies",
+                            type(e).__name__, e,
+                        )
+                        return _Skipped(seeds, e)
+                    raise
+                attempt += 1
+                self.retries_total += 1
+                delay = min(
+                    self.backoff * 2.0 ** (attempt - 1), self.backoff_cap
+                ) * (1.0 + self.jitter * self._jitter_rng.random())
+                self._observe("prefetch.retry_wait", delay)
+                if delay > 0:
+                    time.sleep(delay)
+            else:
+                self._observe(
+                    "prefetch.dispatch", time.perf_counter() - t0
+                )
+                return batch
+
     def run(self, seed_stream: Iterable) -> Iterator[Batch]:
         """Yield Batches for each seed array in ``seed_stream``, keeping up
-        to ``depth`` in flight. Exceptions from the worker surface at the
-        yield for the offending batch, in order.
+        to ``depth`` in flight. Exceptions from the worker (after any
+        retries) surface at the yield for the offending batch, in order;
+        under ``skip_policy="skip"`` the failed batch is silently dropped
+        from the stream instead (later batches keep their order).
 
         A consumer that stops early (``break`` / ``gen.close()``) returns
         promptly: queued dispatches are cancelled and the pool is shut down
@@ -87,11 +200,15 @@ class Prefetcher:
         it = iter(seed_stream)
         try:
             for seeds in it:
-                inflight.append(pool.submit(self._dispatch, seeds))
+                inflight.append(pool.submit(self._dispatch_resilient, seeds))
                 if len(inflight) > self.depth:
-                    yield inflight.popleft().result()
+                    batch = inflight.popleft().result()
+                    if not isinstance(batch, _Skipped):
+                        yield batch
             while inflight:
-                yield inflight.popleft().result()
+                batch = inflight.popleft().result()
+                if not isinstance(batch, _Skipped):
+                    yield batch
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
 

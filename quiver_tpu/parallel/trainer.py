@@ -40,12 +40,16 @@ from ..core.config import parse_size_bytes
 from ..feature.feature import Feature
 from ..feature.shard import ShardedFeature
 from ..obs.registry import (
+    GUARD_NONFINITE,
+    GUARD_SKIPPED,
     ROUTED_OVERFLOW,
     SAMPLE_OVERFLOW,
     TIER_HITS,
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
+from ..resilience.faults import Preemption
+from ..resilience.guard import guard_verdict, guarded_update
 from ..utils.trace import info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
 from ..parallel.pipeline import Prefetcher
@@ -72,6 +76,17 @@ class DistributedTrainer:
       model: flax module with (x, adjs, train=...) signature.
       tx: optax optimizer.
       local_batch: per-device seed-block size (padded).
+      nonfinite_guard: compile the non-finite step guard into the step —
+        a NaN/Inf loss or gradient cond-skips the optimizer update
+        (params/opt_state pass through bit-unchanged) on a mesh-agreed
+        verdict; skip/non-finite counters ride the metrics registry.
+      fault_plan: a resilience.FaultPlan for deterministic chaos drills
+        (in-program NaN feature rows at planned steps, simulated
+        preemption); None = no injection compiled in.
+      checkpoint_dir / checkpoint_every / checkpoint_keep: enable async
+        orbax checkpointing — epoch_scan saves (params, opt_state, step,
+        PRNG key) every ``checkpoint_every`` steps (between scan chunks),
+        keeping ``checkpoint_keep`` checkpoints; see :meth:`resume`.
     """
 
     def __init__(
@@ -87,6 +102,11 @@ class DistributedTrainer:
         replicate_budget: int | str | None = None,
         auto_alpha: bool = False,
         collect_metrics: bool = True,
+        nonfinite_guard: bool = False,
+        fault_plan=None,
+        checkpoint_dir=None,
+        checkpoint_every: int = 0,
+        checkpoint_keep: int = 3,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -168,6 +188,63 @@ class DistributedTrainer:
             doc="per-hop fallback-served lanes of the topo-sharded "
                 "sampler (seeds-outward)",
         )
+        # resilience (resilience/): nonfinite_guard=True compiles the
+        # non-finite step guard into the step body — a NaN/Inf loss or
+        # gradient cond-skips the optimizer update (params/opt_state pass
+        # through bit-unchanged) with a mesh-psum'd verdict so every chip
+        # takes the same branch. The guard's counters ride the registry
+        # only when the guard is on: a guard-off program carries zero
+        # extra values and its loss trajectory is the bit-identical
+        # baseline (tests/test_resilience.py differential).
+        self.nonfinite_guard = bool(nonfinite_guard)
+        if self.nonfinite_guard:
+            self.metrics.counter(
+                GUARD_SKIPPED, unit="steps",
+                doc="optimizer updates cond-skipped by the non-finite "
+                    "step guard (mesh-agreed verdict)",
+            )
+            self.metrics.counter(
+                GUARD_NONFINITE, unit="values",
+                doc="non-finite loss/grad values detected before the "
+                    "gradient pmean",
+            )
+        # fault_plan: deterministic chaos schedule (resilience/faults.py).
+        # Step indices mean the epoch_scan row (or the eager step() call
+        # count): planned steps get their gathered features NaN-poisoned
+        # in-program, and the planned preemption raises Preemption once
+        # the step has run but before its checkpoint lands.
+        self.fault_plan = fault_plan
+        self._fault_step = 0  # eager step() call counter the plan indexes
+        self._preempt_fired = False
+        # checkpoint/auto-resume: checkpoint_dir= + checkpoint_every=
+        # drive async orbax saves of (params, opt_state, step, PRNG key)
+        # between scan chunks; resume() restores the latest and the
+        # caller replays the packed seed stream from the saved step
+        # (bit-identical trajectory — pack_epoch is deterministic per
+        # seed, and the per-step keys are split from the saved key0).
+        self.checkpoint_every = int(checkpoint_every)
+        if checkpoint_dir is not None:
+            if self.checkpoint_every < 1:
+                raise ValueError(
+                    "checkpoint_dir= requires checkpoint_every >= 1 "
+                    f"(got {checkpoint_every})"
+                )
+            from ..utils.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(
+                checkpoint_dir, max_to_keep=checkpoint_keep
+            )
+            latest = self.checkpointer.latest_step()
+            # a pre-existing run directory: keep manager ids monotonic
+            self._ckpt_seq = 0 if latest is None else latest + 1
+        else:
+            if self.checkpoint_every:
+                raise ValueError(
+                    "checkpoint_every= without checkpoint_dir= has "
+                    "nothing to write to"
+                )
+            self.checkpointer = None
+            self._ckpt_seq = 0
         # host-side stage timeline (streaming p50/p95/p99); step() and
         # epoch_scan() time their eager dispatch, callers can add their own
         # stages (or feed it via Timer(registry=trainer.timeline))
@@ -374,6 +451,14 @@ class DistributedTrainer:
         routed_alpha = self.routed_alpha
         topo_sharded = self.topo_sharded
         metrics = self.metrics
+        guard = self.nonfinite_guard
+        # fault injection is compiled in ONLY when the plan schedules NaN
+        # steps: a plan-free program is byte-for-byte the baseline
+        inject_rows = (
+            int(self.fault_plan.nan_rows)
+            if self.fault_plan is not None and self.fault_plan.injects_nan()
+            else 0
+        )
         node_count = sampler.csr_topo.node_count
         rows_per_shard = (
             sampler.topo.rows_per_shard if topo_sharded else 0
@@ -438,7 +523,7 @@ class DistributedTrainer:
             )
             return x, ov_box[0], hits
 
-        def body(params, opt_state, topo, parts, seeds, labels, key):
+        def body(params, opt_state, topo, parts, seeds, labels, key, inject):
             # distinct key per seed-block worker; under "data" sharding the
             # feature-axis members share the key (identical redundant
             # sampling); separate streams for sampling vs dropout
@@ -473,6 +558,19 @@ class DistributedTrainer:
                 )
                 sample_ov = jnp.zeros((len(sizes),), jnp.int32)
             x, routed_ov, tier_hits = gather_features(parts, n_id)
+            if inject_rows:
+                # FaultPlan NaN injection: poison the leading rows of the
+                # gathered block on planned steps (inject is the per-step
+                # plan flag) — a corrupt batch reaching the loss, which
+                # the non-finite guard below must absorb
+                if not jnp.issubdtype(x.dtype, jnp.inexact):
+                    raise ValueError(
+                        f"FaultPlan NaN injection needs float features, "
+                        f"got {x.dtype}"
+                    )
+                rows = min(inject_rows, int(x.shape[0]))
+                poison = jnp.full((rows, x.shape[1]), jnp.nan, x.dtype)
+                x = x.at[:rows].set(jnp.where(inject, poison, x[:rows]))
             lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
             mask = jnp.arange(seeds.shape[0]) < num_seeds
 
@@ -484,6 +582,10 @@ class DistributedTrainer:
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             axes = (DATA_AXIS, FEATURE_AXIS)
+            if guard:
+                # verdict BEFORE the pmean (it spreads one worker's NaN
+                # mesh-wide); psum'd over both axes so every chip agrees
+                ok, local_bad = guard_verdict(loss, grads, axes)
             grads = jax.lax.pmean(grads, axes)
             loss = jax.lax.pmean(loss, axes)
             # graftscope: the step's telemetry rides ONE metrics pytree.
@@ -502,8 +604,21 @@ class DistributedTrainer:
                      psum=axes if routed else DATA_AXIS)
             if topo_sharded:
                 tape.add(SAMPLE_OVERFLOW, sample_ov, psum=DATA_AXIS)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if guard:
+                # local_bad counts this worker's non-finite values; under
+                # "data" sharding the feature-group members recompute the
+                # SAME grads, so summing them too would overcount F times
+                # (same discipline as tier_hits). The skip flag is already
+                # mesh-agreed (psum'd verdict) — no further reduction.
+                tape.add(GUARD_NONFINITE, local_bad,
+                         psum=axes if routed else DATA_AXIS)
+                tape.add(GUARD_SKIPPED, (~ok).astype(jnp.int32))
+                params, opt_state = guarded_update(
+                    tx, grads, opt_state, params, ok
+                )
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             return params, opt_state, loss, tape.finalize()
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
@@ -521,7 +636,8 @@ class DistributedTrainer:
             body,
             mesh=mesh,
             in_specs=(
-                P(), P(), topo_spec, parts_spec, self._seed_spec(), P(), P(),
+                P(), P(), topo_spec, parts_spec, self._seed_spec(), P(),
+                P(), P(),
             ),
             out_specs=(P(), P(), P(), metric_specs),
             check_vma=False,
@@ -606,6 +722,9 @@ class DistributedTrainer:
         the jit cache, so the program retraces on the new split).
         """
         feature = self.feature
+        plan = self.fault_plan
+        step_idx = self._fault_step
+        self._fault_step += 1
         with self.timeline.stage("step"):
             if isinstance(feature, ShardedFeature) and feature.auto_split:
                 feature._maybe_auto_split()
@@ -615,15 +734,24 @@ class DistributedTrainer:
                 jnp.asarray(packed),
                 NamedSharding(self.mesh, self._seed_spec()),
             )
+            inject = jnp.asarray(
+                plan is not None and plan.nan_at(step_idx)
+            )
             params, opt_state, loss, mtree = self._step(
                 params, opt_state, self.topo, self._feature_parts(), packed,
-                labels, key
+                labels, key, inject
             )
         self.metrics.record(mtree)
         if mtree and isinstance(feature, ShardedFeature):
             # hand the batch totals to the store so its eager split tuner
             # sees the fused path's traffic too
             feature.last_tier_hits = mtree[TIER_HITS]
+        if (plan is not None and not self._preempt_fired
+                and plan.preempts_in(step_idx, step_idx + 1)):
+            # the step ran but its results are lost with the raise — the
+            # caller resumes from the last checkpoint, like a real kill
+            self._preempt_fired = True
+            raise Preemption(f"simulated preemption at step {step_idx}")
         return params, opt_state, loss
 
     def pack_epoch(self, train_idx: np.ndarray, seed=None, key=None):
@@ -653,25 +781,32 @@ class DistributedTrainer:
     def _build_epoch(self):
         step = self._step  # jitted shard_map; inlines under the outer jit
 
+        # per-step keys arrive PRE-SPLIT (epoch_scan splits key0 eagerly —
+        # a deterministic function of key0 and the FULL step count), so a
+        # checkpoint-chunked epoch and a resumed one consume exactly the
+        # slices an unchunked scan would have drawn: bit-identical keys
+        # regardless of where the chunk/resume boundaries fall
         @jax.jit
-        def fn(params, opt_state, topo, parts, seed_mat, labels, key0):
-            keys = jax.random.split(key0, seed_mat.shape[0])
-
+        def fn(params, opt_state, topo, parts, seed_mat, labels, keys,
+               inject_vec):
             def body(carry, xs):
                 p, o = carry
-                seeds, k = xs
-                p, o, loss, mtree = step(p, o, topo, parts, seeds, labels, k)
+                seeds, k, inj = xs
+                p, o, loss, mtree = step(
+                    p, o, topo, parts, seeds, labels, k, inj
+                )
                 return (p, o), (loss, mtree)
 
             (p, o), (losses, mtrees) = jax.lax.scan(
-                body, (params, opt_state), (seed_mat, keys)
+                body, (params, opt_state), (seed_mat, keys, inject_vec)
             )
             # mtrees: each metric stacked to (steps,) + its per-step shape
             return p, o, losses, mtrees
 
         return fn  # jit's shape-keyed cache handles distinct step counts
 
-    def epoch_scan(self, params, opt_state, seed_mat, labels, key):
+    def epoch_scan(self, params, opt_state, seed_mat, labels, key,
+                   epoch: int = 0, start_step: int = 0):
         """A whole epoch as ONE compiled program: ``lax.scan`` over the
         packed per-step seed blocks with (params, opt_state) in the carry.
 
@@ -689,19 +824,150 @@ class DistributedTrainer:
         auto-tuners and scoreboard. The split is frozen for the scanned
         epoch (one compiled program); the eager tuner moves it between
         epochs.
+
+        Resilience: with ``checkpoint_dir=``/``checkpoint_every=`` set the
+        epoch runs as scan CHUNKS of ``checkpoint_every`` steps, with an
+        async save of (params, opt_state, step, PRNG key) after each chunk
+        — the device still never waits on the host inside a chunk.
+        ``start_step``/``epoch`` replay a resumed epoch: pass the SAME
+        packed ``seed_mat`` (``pack_epoch`` with the same seed) and the
+        key returned by :meth:`resume`, and the remaining trajectory is
+        bit-identical to the uninterrupted run (per-step keys are split
+        from key0 over the FULL step count, then sliced). A ``fault_plan``
+        with ``preempt_at_step`` raises
+        :class:`~quiver_tpu.resilience.Preemption` once that step's chunk
+        has run but before its checkpoint lands (the drill's "kill").
         """
+        steps = int(np.shape(seed_mat)[0])
+        start = int(start_step)
+        if not 0 <= start <= steps:
+            raise ValueError(
+                f"start_step {start} outside [0, {steps}] for a "
+                f"{steps}-step epoch"
+            )
+        plan = self.fault_plan
+        losses_parts: list = []
+        mtrees_parts: list = []
         with self.timeline.stage("epoch_scan"):
             self._maybe_grow_routed_alpha()
             packed = jax.device_put(
                 jnp.asarray(seed_mat),
                 NamedSharding(self.mesh, P(None, *self._seed_spec())),
             )
-            params, opt_state, losses, mtrees = self._epoch_fn(
-                params, opt_state, self.topo, self._feature_parts(), packed,
-                labels, key
+            keys = jax.random.split(key, steps)
+            if plan is not None and plan.injects_nan():
+                inject_vec = jnp.asarray(plan.nan_mask(steps))
+            else:
+                inject_vec = jnp.zeros((steps,), bool)
+            chunk = (
+                self.checkpoint_every if self.checkpointer is not None
+                else max(steps - start, 1)
             )
+            lo = start
+            while lo < steps:
+                hi = min(lo + chunk, steps)
+                params, opt_state, losses, mtrees = self._epoch_fn(
+                    params, opt_state, self.topo, self._feature_parts(),
+                    packed[lo:hi], labels, keys[lo:hi], inject_vec[lo:hi]
+                )
+                losses_parts.append(losses)
+                mtrees_parts.append(mtrees)
+                if (plan is not None and not self._preempt_fired
+                        and plan.preempts_in(lo, hi)):
+                    # the chunk ran but dies un-checkpointed — resume()
+                    # restores step `lo` and replays from there
+                    self._preempt_fired = True
+                    raise Preemption(
+                        f"simulated preemption at step "
+                        f"{plan.preempt_at_step}: chunk [{lo}, {hi}) lost "
+                        f"(last checkpoint at step {lo})"
+                    )
+                if self.checkpointer is not None:
+                    self._save_checkpoint(params, opt_state, key, epoch, hi)
+                lo = hi
+        if len(losses_parts) == 1:
+            losses, mtrees = losses_parts[0], mtrees_parts[0]
+        elif losses_parts:
+            losses = jnp.concatenate(losses_parts)
+            mtrees = {
+                name: jnp.concatenate([m[name] for m in mtrees_parts])
+                for name in mtrees_parts[0]
+            }
+        else:  # start == steps: a resumed, already-finished epoch
+            losses, mtrees = jnp.zeros((0,), jnp.float32), {}
         self.metrics.record(mtrees)
         return params, opt_state, losses
+
+    # -- checkpoint / auto-resume -------------------------------------------
+
+    def _save_checkpoint(self, params, opt_state, key, epoch, step) -> None:
+        """Async orbax save between scan chunks. ``step`` counts completed
+        rows of the CURRENT epoch's packed seed matrix; ``key`` is the
+        epoch's key0 (stored as raw key data — restore re-splits it)."""
+        if hasattr(key, "dtype") and jnp.issubdtype(
+                key.dtype, jax.dtypes.prng_key):
+            key_data = jax.random.key_data(key)
+        else:
+            key_data = jnp.asarray(key)
+        state = {
+            "params": params,
+            "opt_state": opt_state,
+            # 0-d ndarrays, not numpy scalars — orbax's StandardSave
+            # rejects bare np.int32 scalar types
+            "step": np.asarray(step, np.int32),
+            "epoch": np.asarray(epoch, np.int32),
+            "key": key_data,
+        }
+        self.checkpointer.save(self._ckpt_seq, state)
+        self._ckpt_seq += 1
+
+    def resume(self, params, opt_state):
+        """Restore the latest checkpoint, if any.
+
+        Returns ``(params, opt_state, key, step, epoch)`` — the restored
+        train state, the saved epoch key0 (raw key data; feed it straight
+        back to :meth:`epoch_scan`), and where training stopped. With no
+        checkpoint on disk the inputs pass through with
+        ``(key=None, step=0, epoch=0)``.
+
+        To reproduce the uninterrupted run bit-identically, regenerate
+        the SAME packed seed matrix (``pack_epoch`` with the same seed —
+        the seed-stream replay) and call
+        ``epoch_scan(..., key=key, epoch=epoch, start_step=step)``: the
+        per-step keys are re-split from the saved key0 over the full
+        epoch, so the remaining steps draw exactly the keys the
+        preempted run would have.
+        """
+        if self.checkpointer is None:
+            raise ValueError(
+                "resume() needs checkpointing enabled "
+                "(checkpoint_dir=/checkpoint_every= at construction)"
+            )
+        self.checkpointer.wait_until_finished()
+        latest = self.checkpointer.latest_step()
+        if latest is None:
+            return params, opt_state, None, 0, 0
+        # restore INTO the caller's freshly-initialized state as the
+        # template: an untemplated orbax restore turns tuples into lists,
+        # which breaks the scan carry's pytree structure downstream
+        template = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": np.zeros((), np.int32),
+            "epoch": np.zeros((), np.int32),
+            "key": np.zeros((2,), np.uint32),  # threefry2x32 key data
+        }
+        state = self.checkpointer.restore(latest, template=template)
+        # orbax commits restored arrays to one device; the step program
+        # wants them mesh-replicated (in_spec P()) — re-anchor explicitly
+        rep = NamedSharding(self.mesh, P())
+        return (
+            jax.device_put(state["params"], rep),
+            jax.device_put(state["opt_state"], rep),
+            jnp.asarray(np.asarray(state["key"])),
+            int(np.asarray(state["step"])),
+            int(np.asarray(state["epoch"])),
+        )
 
     # graftlint: eager -- between-batch tuner on host numpy telemetry; the
     def _maybe_grow_routed_alpha(self) -> None:  # step program never calls it
@@ -995,6 +1261,13 @@ class DataParallelTrainer:
         """
         rng = rng or np.random.default_rng(0)
         train_idx = np.asarray(train_idx)
+        if train_idx.size == 0:
+            # a silent float("nan") mean loss poisons every downstream
+            # consumer (schedulers, early stopping, logs) — fail loudly
+            raise ValueError(
+                "train_epoch got an empty seed set (train_idx) — nothing "
+                "to train on; check the split/filter that produced it"
+            )
         perm = rng.permutation(len(train_idx))
         steps = max(len(train_idx) // self.global_batch, 1)
         blocks = []

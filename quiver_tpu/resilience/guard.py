@@ -1,0 +1,73 @@
+"""In-program non-finite step guard (trace-safe, mesh-agreed).
+
+One NaN batch inside ``epoch_scan`` poisons params for the rest of the
+epoch — and because the epoch is ONE compiled program, the host only finds
+out after the full loss vector reads back. The guard runs inside the
+traced step body: count non-finite loss/grad values per worker, psum the
+count mesh-wide so every chip reaches the same verdict, and cond-skip the
+optimizer update when any worker saw a non-finite value. The skipped
+step's params/opt_state pass through bit-unchanged; the (NaN) loss still
+lands in the trajectory so the skip is visible to the host.
+
+Both cond branches return the same ``(params, opt_state)`` pytree — the
+cond-branch-parity discipline graftlint enforces on the psum-fallback
+conds (``parallel/routing.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["nonfinite_count", "guard_verdict", "guarded_update"]
+
+
+def nonfinite_count(tree) -> jnp.ndarray:
+    """int32 scalar: number of non-finite elements across the inexact
+    leaves of ``tree``. Integer leaves cannot hold non-finite values and
+    contribute zero (dtype inspected at trace time — no host op on a
+    tracer)."""
+    total = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            total = total + jnp.sum(
+                (~jnp.isfinite(leaf)).astype(jnp.int32)
+            )
+    return total
+
+
+def guard_verdict(loss, grads, axes):
+    """Mesh-agreed step verdict, computed BEFORE the gradient pmean (the
+    pmean spreads one worker's NaN to every chip; counting pre-pmean
+    attributes the fault to the workers that produced it).
+
+    Returns ``(ok, local_bad)``: ``local_bad`` is this worker's int32
+    non-finite count over ``(loss, grads)``; ``ok`` is True iff the psum
+    of that count over ``axes`` is zero — every chip computes the same
+    verdict, so the cond below takes the same branch mesh-wide.
+    """
+    local_bad = nonfinite_count((loss, grads))
+    total_bad = jax.lax.psum(local_bad, axes)
+    return total_bad == 0, local_bad
+
+
+def guarded_update(tx, grads, opt_state, params, ok):
+    """Cond-gated optimizer update: when ``ok`` is False the update is
+    skipped and ``(params, opt_state)`` pass through bit-unchanged — the
+    poisoned gradients never touch the optimizer. ``ok`` must be
+    mesh-agreed (see :func:`guard_verdict`); a per-worker verdict would
+    desync params across chips."""
+
+    def apply_branch(operand):
+        params, opt_state, grads = operand
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def skip_branch(operand):
+        params, opt_state, _ = operand
+        return params, opt_state
+
+    return jax.lax.cond(
+        ok, apply_branch, skip_branch, (params, opt_state, grads)
+    )

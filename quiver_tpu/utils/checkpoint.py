@@ -38,11 +38,30 @@ class Checkpointer:
             ),
         )
 
-    def save(self, step: int, state, wait: bool = False) -> None:
-        """Save a state pytree at ``step`` (async by default)."""
-        self._mngr.save(int(step), args=ocp.args.StandardSave(state))
+    def save(self, step: int, state, wait: bool = False) -> bool:
+        """Save a state pytree at ``step`` (async by default).
+
+        Returns whether orbax ACCEPTED the save — it returns False when
+        the manager's should-save policy rejects it (e.g. a step that is
+        already checkpointed). Swallowing that bool means a caller can
+        believe state is durable when nothing was written, so a rejection
+        is also logged (once per process)."""
+        saved = bool(
+            self._mngr.save(int(step), args=ocp.args.StandardSave(state))
+        )
+        if not saved:
+            from .trace import info_once
+
+            info_once(
+                "checkpoint-save-rejected",
+                "Checkpointer.save(step=%d) was REJECTED by orbax (e.g. "
+                "the step is already checkpointed) — nothing was written; "
+                "further rejections in this process stay silent",
+                int(step),
+            )
         if wait:
             self._mngr.wait_until_finished()
+        return saved
 
     def restore(self, step: int | None = None, template=None):
         """Restore the state at ``step`` (default: latest).
@@ -68,6 +87,9 @@ class Checkpointer:
         self._mngr.wait_until_finished()
 
     def close(self) -> None:
+        """Wait for in-flight async saves, then release the manager — a
+        close racing an async commit must not lose the tail checkpoint."""
+        self._mngr.wait_until_finished()
         self._mngr.close()
 
     def __enter__(self):

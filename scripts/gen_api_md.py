@@ -37,6 +37,8 @@ SECTIONS = [
     ("quiver_tpu.parallel.trainer", "Distributed fused trainer"),
     ("quiver_tpu.parallel.train", "Single-chip train step helpers"),
     ("quiver_tpu.parallel.pipeline", "Prefetcher"),
+    ("quiver_tpu.resilience",
+     "Fault tolerance — non-finite step guard, fault injection"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),

@@ -54,6 +54,10 @@ ORDER = [
     # regression fails the session before any chip-window time is burned
     # on benchmarks whose numbers a broken invariant would poison
     ("lint", 120),
+    # chaos drills right after lint: resilience regressions (guard,
+    # retry, checkpoint/resume bit-parity) fail the session early, before
+    # bench budget burns on a stack that can't survive a bad batch
+    ("chaos", 600),
     ("primitives", 600),
     ("sampler-hbm", 1800),
     ("feature-replicate", 1200),
@@ -91,6 +95,9 @@ EXTRA_JOBS = {
     "lint": ("quiver_tpu.tools.lint",
              [os.path.join(REPO, d)
               for d in ("quiver_tpu", "scripts", "benchmarks")]),
+    # FaultPlan smoke over a tiny epoch (guard skip, prefetch retry,
+    # preempt/resume bit-parity) — log-only, asserts its own invariants
+    "chaos": ("benchmarks.chaos", []),
 }
 
 
@@ -112,12 +119,12 @@ def job_table():
                          f"{sorted(unordered)}")
     return [(k, by_key[k][0], list(by_key[k][1]), b) for k, b in ORDER]
 
-# jobs whose records feed the scoreboard table (acceptance/sweep/lint
-# log-only)
-TABLE_EXCLUDE = {"acceptance", "sweep", "lint"}
+# jobs whose records feed the scoreboard table (acceptance/sweep/lint/
+# chaos log-only)
+TABLE_EXCLUDE = {"acceptance", "sweep", "lint", "chaos"}
 
 # jobs that emit no {"metric": ...} records; success = clean exit alone
-LOG_ONLY_JOBS = {"acceptance", "lint"}
+LOG_ONLY_JOBS = {"acceptance", "lint", "chaos"}
 
 
 class JobTimeout(Exception):

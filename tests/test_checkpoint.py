@@ -70,3 +70,34 @@ def test_resume_training_continues_identically(tmp_path):
         for i in range(3, 6):
             p2, o2 = step(p2, o2, i)
     _tree_equal(p2, params)
+
+
+def test_save_rejection_surfaces_as_false(tmp_path, caplog):
+    """orbax rejects a re-save of an already-checkpointed step: save()
+    must return False (and log once) instead of silently dropping it."""
+    import logging
+
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        assert ckpt.save(5, {"x": jnp.zeros(2)}, wait=True) is True
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            assert ckpt.save(5, {"x": jnp.ones(2)}, wait=True) is False
+            assert ckpt.save(5, {"x": jnp.ones(2)}, wait=True) is False
+        rejections = [r for r in caplog.records if "REJECTED" in r.message]
+        assert len(rejections) == 1  # one-shot log
+        _tree_equal(ckpt.restore(), {"x": jnp.zeros(2)})  # original stands
+
+
+def test_close_waits_for_inflight_async_save(tmp_path):
+    """close() must flush the pending async save — a reopened manager sees
+    the step that was still committing at close time."""
+    ckpt = Checkpointer(tmp_path / "ck")
+    ckpt.save(1, {"x": jnp.full(3, 7.0)})  # async, no wait
+    ckpt.close()
+    with Checkpointer(tmp_path / "ck") as reopened:
+        assert reopened.latest_step() == 1
+        # template restore: a freshly-opened manager has no handler
+        # registry yet, so an untemplated restore cannot infer the tree
+        _tree_equal(
+            reopened.restore(template={"x": jnp.zeros(3)}),
+            {"x": jnp.full(3, 7.0)},
+        )

@@ -482,3 +482,28 @@ def test_epoch_scan_gat():
             first = losses[0]
         last = losses[-1]
     assert last < first, (first, last)
+
+
+def test_train_epoch_empty_seed_set_raises():
+    """An empty train_idx used to silently return a float("nan") mean loss
+    (trainer.py train_epoch) — it must fail loudly instead."""
+    from quiver_tpu.parallel.trainer import DataParallelTrainer
+
+    ei, feat, _ = _labeled_graph(n=200)
+    topo = CSRTopo(edge_index=ei)
+    mesh = make_mesh(data=8, feature=1)
+    sampler = GraphSageSampler(topo, [3], seed_capacity=8, seed=0)
+    feature = Feature(device_cache_size="1G").from_cpu_tensor(
+        feat[: topo.node_count]
+    )
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=1)
+    trainer = DataParallelTrainer(
+        mesh, sampler, feature, model, optax.sgd(1e-2), local_batch=8
+    )
+    params, opt_state = trainer.init(jax.random.PRNGKey(0))
+    labels = jnp.zeros(topo.node_count, jnp.int32)
+    with pytest.raises(ValueError, match="empty seed set"):
+        trainer.train_epoch(
+            params, opt_state, np.array([], np.int64), labels,
+            jax.random.PRNGKey(1),
+        )

@@ -1,5 +1,6 @@
 """Prefetcher tests: determinism vs the sequential loop, depth semantics,
-error propagation, early exit."""
+error propagation, early exit, and the resilience layer's bounded
+retry/backoff/skip behavior under a deterministic FaultPlan."""
 
 import threading
 import time
@@ -136,3 +137,120 @@ def test_early_exit_returns_promptly_despite_inflight_dispatch(setup):
     dt = time.perf_counter() - t0
     release.set()  # let the background worker finish and exit
     assert dt < 5.0, f"early exit blocked {dt:.1f}s on the in-flight batch"
+
+
+# -- retry / skip resilience (resilience/faults.py is the fault source) -------
+
+
+def _fresh_sampler(topo):
+    return GraphSageSampler(topo, [3], seed_capacity=16, seed=0)
+
+
+def test_retry_recovers_transient_faults_bit_identically(setup):
+    """Two injected transient failures on batch 1: with retries the stream
+    completes AND matches a fault-free sequential run bitwise — a failed
+    call never reaches the wrapped sampler, so PRNG call order holds."""
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.resilience import FaultPlan
+
+    topo, _ = setup
+    seeds = _seed_stream(4, 16, topo.node_count)
+    oracle = _fresh_sampler(topo)  # one sampler: PRNG advances per batch
+    clean = [oracle.sample(s) for s in seeds]
+
+    faulty = FaultPlan(sampler_faults={1: 2}).wrap_sampler(
+        _fresh_sampler(topo)
+    )
+    tl = StepTimeline()
+    pf = Prefetcher(faulty, None, depth=2, retries=3, backoff=1e-4,
+                    timeline=tl)
+    batches = list(pf.run(seeds))
+    assert len(batches) == 4
+    assert pf.retries_total == 2 and pf.skips_total == 0
+    assert tl.stats("prefetch.retry_wait").count == 2
+    assert tl.stats("prefetch.dispatch").count == 4
+    for c, b in zip(clean, batches):
+        np.testing.assert_array_equal(
+            np.asarray(c.n_id), np.asarray(b.out.n_id)
+        )
+
+
+def test_retry_exhaustion_raises_in_order(setup):
+    from quiver_tpu.resilience import FaultPlan, TransientFault
+
+    topo, _ = setup
+    seeds = _seed_stream(4, 16, topo.node_count)
+    faulty = FaultPlan(sampler_faults={1: 3}).wrap_sampler(
+        _fresh_sampler(topo)
+    )
+    got = []
+    with pytest.raises(TransientFault, match="batch 1"):
+        for b in Prefetcher(faulty, None, depth=1, retries=1,
+                            backoff=0.0).run(seeds):
+            got.append(b)
+    assert len(got) == 1  # batch 0 delivered before the failure surfaced
+
+
+def test_skip_policy_drops_poisoned_batch_keeps_order(setup):
+    """A permanently-failing batch under skip_policy="skip": dropped and
+    counted; the survivors match a clean run over the surviving seed list
+    (the skipped batch never consumed a sampler draw)."""
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.resilience import FaultPlan
+
+    topo, _ = setup
+    seeds = _seed_stream(4, 16, topo.node_count)
+    faulty = FaultPlan(sampler_faults={1: 10**9}).wrap_sampler(
+        _fresh_sampler(topo)
+    )
+    tl = StepTimeline()
+    pf = Prefetcher(faulty, None, depth=2, retries=1, backoff=0.0,
+                    skip_policy="skip", timeline=tl)
+    batches = list(pf.run(seeds))
+    assert len(batches) == 3
+    assert pf.skips_total == 1 and pf.retries_total == 1
+    assert tl.stats("prefetch.skip").count == 1
+    survivor = _fresh_sampler(topo)
+    for s, b in zip((seeds[0], seeds[2], seeds[3]), batches):
+        np.testing.assert_array_equal(
+            np.asarray(survivor.sample(s).n_id), np.asarray(b.out.n_id)
+        )
+
+
+def test_retry_knob_validation(setup):
+    topo, _ = setup
+    sampler = _fresh_sampler(topo)
+    with pytest.raises(ValueError, match="retries"):
+        Prefetcher(sampler, retries=-1)
+    with pytest.raises(ValueError, match="skip_policy"):
+        Prefetcher(sampler, skip_policy="drop")
+    with pytest.raises(ValueError, match="backoff"):
+        Prefetcher(sampler, backoff=-0.1)
+
+
+def test_retry_backoff_is_bounded_and_jitter_deterministic(setup):
+    """Backoff doubles then caps; the jitter PRNG is seeded, so two
+    prefetchers with the same retry_seed observe identical sleeps."""
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.resilience import FaultPlan
+
+    topo, _ = setup
+    seeds = _seed_stream(2, 16, topo.node_count)
+
+    def waits(retry_seed):
+        faulty = FaultPlan(sampler_faults={0: 4}).wrap_sampler(
+            _fresh_sampler(topo)
+        )
+        tl = StepTimeline()
+        pf = Prefetcher(faulty, None, retries=4, backoff=1e-3,
+                        backoff_cap=2e-3, jitter=0.5, timeline=tl,
+                        retry_seed=retry_seed)
+        assert len(list(pf.run(seeds))) == 2
+        st = tl.stats("prefetch.retry_wait")
+        return st.count, st.max
+
+    count_a, max_a = waits(5)
+    count_b, max_b = waits(5)
+    assert count_a == count_b == 4
+    assert max_a == max_b  # same seed, same jitter draws
+    assert max_a <= 2e-3 * 1.5 + 1e-9  # cap * (1 + jitter)

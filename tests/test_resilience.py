@@ -1,0 +1,292 @@
+"""Resilience-layer tests: non-finite step guard, deterministic fault
+injection, checkpoint/auto-resume.
+
+Fast lane: FaultPlan semantics, guard skip/counter behavior over eager
+steps, constructor validation, empty-checkpoint resume passthrough.
+Slow lane: the epoch-level differentials — guard on/off bit-parity with
+zero faults, and the preemption drill (kill at step k via FaultPlan,
+resume, compare the remaining loss trajectory bitwise).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from quiver_tpu import CSRTopo, FaultPlan, GraphSageSampler, Preemption
+from quiver_tpu.feature.shard import ShardedFeature
+from quiver_tpu.models.sage import GraphSAGE
+from quiver_tpu.obs.registry import GUARD_NONFINITE, GUARD_SKIPPED
+from quiver_tpu.parallel.mesh import make_mesh
+from quiver_tpu.parallel.trainer import DistributedTrainer
+from quiver_tpu.resilience import TransientFault
+from quiver_tpu.resilience.guard import nonfinite_count
+
+
+def _tree_bitwise_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def _build_trainer(guard=False, plan=None, checkpoint_dir=None,
+                   checkpoint_every=0):
+    rng = np.random.default_rng(0)
+    n = 96
+    topo = CSRTopo(
+        edge_index=rng.integers(0, n, size=(2, 800)).astype(np.int64)
+    )
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(
+        mesh, device_cache_size=n * 8, csr_topo=topo
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [3, 2], seed=0, seed_capacity=8)
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=2)
+    kw = {}
+    if checkpoint_dir is not None:
+        kw = dict(checkpoint_dir=checkpoint_dir,
+                  checkpoint_every=checkpoint_every)
+    trainer = DistributedTrainer(
+        mesh, sampler, store, model, optax.sgd(1e-2), local_batch=8,
+        seed_sharding="all", nonfinite_guard=guard, fault_plan=plan, **kw
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    labels = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+    return trainer, params, opt, labels
+
+
+# -- FaultPlan (host-side, no compile) ----------------------------------------
+
+
+def test_fault_plan_masks_and_queries():
+    plan = FaultPlan(nan_feature_steps=(1, 3), nan_rows=2,
+                     preempt_at_step=5)
+    assert plan.injects_nan() and plan.nan_at(3) and not plan.nan_at(2)
+    np.testing.assert_array_equal(
+        plan.nan_mask(5), [False, True, False, True, False]
+    )
+    assert plan.preempts_in(3, 6) and not plan.preempts_in(0, 5)
+    assert not FaultPlan().injects_nan()
+    assert not FaultPlan().preempts_in(0, 10**6)
+
+
+def test_fault_plan_chaos_is_seed_deterministic():
+    a = FaultPlan.chaos(seed=7, steps=50, nan_p=0.2, transient_p=0.3)
+    b = FaultPlan.chaos(seed=7, steps=50, nan_p=0.2, transient_p=0.3)
+    assert a == b
+    c = FaultPlan.chaos(seed=8, steps=50, nan_p=0.2, transient_p=0.3)
+    assert a != c
+    assert a.nan_feature_steps  # p=0.2 over 50 steps: drew something
+
+
+def test_fault_plan_validation():
+    with pytest.raises(ValueError, match="nan_rows"):
+        FaultPlan(nan_rows=0)
+    with pytest.raises(ValueError, match="sampler_faults"):
+        FaultPlan(sampler_faults={-1: 2})
+    with pytest.raises(ValueError, match="feature_faults"):
+        FaultPlan(feature_faults={0: 0})
+
+
+def test_faulty_feature_injects_nan_and_faults():
+    feat = np.ones((10, 4), np.float32)
+
+    class Store:
+        def __getitem__(self, ids):
+            return feat[ids]
+
+    plan = FaultPlan(feature_faults={1: 2}, nan_feature_steps=(1,),
+                     nan_rows=2)
+    wrapped = plan.wrap_feature(Store())
+    ids = np.arange(3)
+    assert np.isfinite(wrapped[ids]).all()  # lookup 0: clean
+    for _ in range(2):  # lookups 1-2 planned transient failures
+        with pytest.raises(TransientFault, match="feature"):
+            wrapped[ids]
+    rows = wrapped[ids]  # successful lookup #1: NaN-poisoned rows
+    assert np.isnan(rows[:2]).all() and np.isfinite(rows[2:]).all()
+
+
+def test_nonfinite_count_ignores_integer_leaves():
+    tree = {
+        "f": jnp.array([1.0, jnp.nan, jnp.inf]),
+        "i": jnp.arange(3),
+        "b": jnp.float32(0.0),
+    }
+    assert int(nonfinite_count(tree)) == 2
+
+
+# -- non-finite step guard (eager steps; the fast-lane guard unit) ------------
+
+
+def test_guard_skips_poisoned_step_and_counts():
+    """Acceptance (fast half): with a NaN batch injected, params/opt_state
+    after the poisoned step equal the ones before it bit-for-bit, the skip
+    counter reads 1 (replicated — every chip agrees), and the next clean
+    step trains normally."""
+    plan = FaultPlan(nan_feature_steps=(1,), nan_rows=4)
+    trainer, params, opt, labels = _build_trainer(guard=True, plan=plan)
+    rng = np.random.default_rng(3)
+
+    params, opt, loss0 = trainer.step(
+        params, opt, rng.integers(0, 96, 64), labels, jax.random.PRNGKey(0)
+    )
+    assert np.isfinite(float(loss0))
+    assert int(np.asarray(trainer.metrics.value(GUARD_SKIPPED))) == 0
+
+    p_before, o_before = params, opt
+    params, opt, loss1 = trainer.step(
+        params, opt, rng.integers(0, 96, 64), labels, jax.random.PRNGKey(1)
+    )
+    # the poisoned step's loss is honestly NaN, but nothing was applied
+    assert not np.isfinite(float(loss1))
+    assert _tree_bitwise_equal(params, p_before)
+    assert _tree_bitwise_equal(opt, o_before)
+    assert int(np.asarray(trainer.metrics.value(GUARD_SKIPPED))) == 1
+    assert int(np.asarray(trainer.metrics.value(GUARD_NONFINITE))) > 0
+
+    params, opt, loss2 = trainer.step(
+        params, opt, rng.integers(0, 96, 64), labels, jax.random.PRNGKey(2)
+    )
+    assert np.isfinite(float(loss2))
+    assert not _tree_bitwise_equal(params, p_before)
+    assert int(np.asarray(trainer.metrics.value(GUARD_SKIPPED))) == 0
+    rep = trainer.metrics_report()
+    assert GUARD_SKIPPED in rep and GUARD_NONFINITE in rep
+
+
+def test_guard_off_registers_no_guard_metrics():
+    trainer, *_ = _build_trainer(guard=False)
+    assert GUARD_SKIPPED not in trainer.metrics.names()
+    assert GUARD_NONFINITE not in trainer.metrics.names()
+
+
+# -- checkpoint knobs ---------------------------------------------------------
+
+
+def test_checkpoint_knob_validation(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        _build_trainer(checkpoint_dir=tmp_path / "ck", checkpoint_every=0)
+    rng = np.random.default_rng(0)
+    topo = CSRTopo(
+        edge_index=rng.integers(0, 96, size=(2, 800)).astype(np.int64)
+    )
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(mesh, device_cache_size=96 * 8).from_cpu_tensor(
+        rng.normal(size=(96, 8)).astype(np.float32)
+    )
+    with pytest.raises(ValueError, match="nothing to write"):
+        DistributedTrainer(
+            mesh, GraphSageSampler(topo, [3], seed=0, seed_capacity=8),
+            store, GraphSAGE(hidden=8, num_classes=4, num_layers=1),
+            optax.sgd(1e-2), local_batch=8, seed_sharding="all",
+            checkpoint_every=4,
+        )
+
+
+def test_resume_without_checkpointing_raises():
+    trainer, params, opt, _ = _build_trainer()
+    with pytest.raises(ValueError, match="resume"):
+        trainer.resume(params, opt)
+
+
+def test_resume_empty_directory_passes_through(tmp_path):
+    trainer, params, opt, _ = _build_trainer(
+        checkpoint_dir=tmp_path / "ck", checkpoint_every=2
+    )
+    p, o, key, step, epoch = trainer.resume(params, opt)
+    assert step == 0 and epoch == 0 and key is None
+    assert p is params and o is opt
+    trainer.checkpointer.close()
+
+
+# -- epoch-level differentials (slow lane) ------------------------------------
+
+
+@pytest.mark.slow
+def test_guard_on_off_loss_bitwise_identical():
+    """Acceptance: with the guard enabled and ZERO injected faults, the
+    epoch_scan loss trajectory is bit-identical to the guard-off path —
+    the verdict psum and cond ride alongside the training math, never
+    inside it."""
+    losses = {}
+    for guard in (False, True):
+        trainer, params, opt, labels = _build_trainer(guard=guard)
+        seed_mat = trainer.pack_epoch(np.tile(np.arange(96), 4), seed=0)
+        _, _, ls = trainer.epoch_scan(
+            params, opt, seed_mat, labels, jax.random.PRNGKey(7)
+        )
+        losses[guard] = np.asarray(ls)
+    np.testing.assert_array_equal(
+        losses[True].view(np.uint32), losses[False].view(np.uint32)
+    )
+
+
+@pytest.mark.slow
+def test_guarded_epoch_scan_skips_injected_nan_step():
+    """A NaN batch inside the SCANNED epoch: the per-step skip vector
+    marks exactly the poisoned step, and the final params equal those of
+    a run over the same seeds with the poisoned step's update elided —
+    i.e. the poison never touched the optimizer."""
+    plan = FaultPlan(nan_feature_steps=(2,), nan_rows=4)
+    trainer, params, opt, labels = _build_trainer(guard=True, plan=plan)
+    seed_mat = trainer.pack_epoch(np.tile(np.arange(96), 4), seed=0)
+    _, _, ls = trainer.epoch_scan(
+        params, opt, seed_mat, labels, jax.random.PRNGKey(7)
+    )
+    skipped = np.asarray(trainer.metrics.value(GUARD_SKIPPED))
+    assert skipped.shape == (seed_mat.shape[0],)
+    expect = np.zeros(seed_mat.shape[0], np.int32)
+    expect[2] = 1
+    np.testing.assert_array_equal(skipped, expect)
+    ls = np.asarray(ls)
+    assert not np.isfinite(ls[2]) and np.isfinite(np.delete(ls, 2)).all()
+
+
+@pytest.mark.slow
+def test_preemption_drill_resume_bit_parity(tmp_path):
+    """Acceptance e2e: crash at step k (FaultPlan preemption) + resume()
+    reproduces the uninterrupted run's remaining loss trajectory and
+    final params bit-identically. Both runs checkpoint every 3 steps so
+    chunk boundaries (and therefore compiled programs) align."""
+    trainer_a, pa, oa, labels = _build_trainer(
+        checkpoint_dir=tmp_path / "a", checkpoint_every=3
+    )
+    seed_mat = trainer_a.pack_epoch(np.tile(np.arange(96), 6), seed=0)
+    assert seed_mat.shape[0] == 9
+    key = jax.random.PRNGKey(7)
+    pa, oa, losses_a = trainer_a.epoch_scan(pa, oa, seed_mat, labels, key)
+    losses_a = np.asarray(losses_a)
+
+    trainer_b, pb, ob, _ = _build_trainer(
+        checkpoint_dir=tmp_path / "b", checkpoint_every=3,
+        plan=FaultPlan(preempt_at_step=4),
+    )
+    p0, o0 = pb, ob
+    with pytest.raises(Preemption, match="step 4"):
+        trainer_b.epoch_scan(pb, ob, seed_mat, labels, key)
+    pr, orr, key_r, step, epoch = trainer_b.resume(p0, o0)
+    assert step == 3 and epoch == 0  # chunk [3, 6) died un-checkpointed
+    # seed-stream replay: same packed matrix, same key0, start at step 3
+    pr, orr, losses_r = trainer_b.epoch_scan(
+        pr, orr, seed_mat, labels, key_r, epoch=epoch, start_step=step
+    )
+    losses_r = np.asarray(losses_r)
+    np.testing.assert_array_equal(
+        losses_r.view(np.uint32), losses_a[step:].view(np.uint32)
+    )
+    assert _tree_bitwise_equal(pa, pr)
+    # a finished epoch resumes to a no-op
+    pr2, or2, key2, step2, _ = trainer_b.resume(p0, o0)
+    assert step2 == seed_mat.shape[0]
+    _, _, empty = trainer_b.epoch_scan(
+        pr2, or2, seed_mat, labels, key2, start_step=step2
+    )
+    assert np.asarray(empty).shape == (0,)
+    trainer_a.checkpointer.close()
+    trainer_b.checkpointer.close()
