@@ -1,7 +1,7 @@
 """Chaos lane: FaultPlan drills over a tiny epoch — the resilience layer's
 evidence job (mega_session ``chaos`` stage, log-only).
 
-Three deterministic drills, each asserting the property the resilience
+Six deterministic drills, each asserting the property the resilience
 layer guarantees (quiver_tpu/resilience/):
 
 * **guard**: a NaN-poisoned batch inside the fused step leaves params
@@ -10,10 +10,23 @@ layer guarantees (quiver_tpu/resilience/):
   Prefetcher's bounded backoff and the delivered stream is bit-identical
   to a fault-free run;
 * **preempt/resume**: a simulated kill mid-epoch, then resume() — the
-  remaining loss trajectory is bit-identical to the uninterrupted run.
+  remaining loss trajectory is bit-identical to the uninterrupted run;
+* **resize**: the elastic drill — kill an F-shard run mid-epoch, resume
+  onto HALF the devices (``resume(mesh=)``: topology + three-tier feature
+  store re-planned, blocks-per-device doubled) and the remaining loss
+  trajectory + final params stay bit-identical to the uninterrupted
+  full-mesh run;
+* **corrupt**: flip manifest-covered bytes in the NEWEST checkpoint (and
+  plant an uncommitted partial directory) — resume() quarantines both and
+  falls back to the previous valid checkpoint, no manual intervention;
+* **cold-outage**: a cold-tier outage (consecutive feature-lookup
+  failures) trips the circuit breaker into degraded serving — the epoch
+  completes with ``resilience.degraded_lookups > 0`` instead of crashing,
+  and a half-open probe closes the breaker once the outage ends.
 
 Any drill failure raises (the session marks the job failed); success
-prints one ``CHAOS <drill> OK`` line per drill.
+prints one ``CHAOS <drill> OK`` line per drill. ``--drills`` selects a
+subset (the CI smoke runs ``--drills corrupt`` on a 2-device CPU mesh).
 
     python -m benchmarks.chaos --smoke
 """
@@ -24,6 +37,8 @@ import tempfile
 import numpy as np
 
 from benchmarks import common
+
+DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -202,6 +217,217 @@ def drill_preempt_resume(topo, feat, labels, local_batch, seed):
     )
 
 
+def _build_elastic_trainer(topo, feat, mesh, local_batch, workers,
+                           checkpoint_dir=None, checkpoint_every=2,
+                           plan=None):
+    """Elastic config: mesh-sharded topology + three-tier sharded feature
+    + logical_workers (the resize drill's trainer shape)."""
+    import optax
+
+    from quiver_tpu import GraphSageSampler
+    from quiver_tpu.feature.shard import ShardedFeature
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.mesh import FEATURE_AXIS
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    n, d = feat.shape
+    F = mesh.shape[FEATURE_AXIS]
+    store = ShardedFeature(
+        mesh,
+        device_cache_size=max(n // (2 * F), 1) * d * feat.dtype.itemsize,
+        replicate_budget=8 * d * feat.dtype.itemsize,
+        csr_topo=topo,
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(
+        topo, [5, 5], seed=3, seed_capacity=local_batch,
+        topo_sharding="mesh", mesh=mesh,
+    )
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    kw = {}
+    if checkpoint_dir is not None:
+        kw = dict(checkpoint_dir=checkpoint_dir,
+                  checkpoint_every=checkpoint_every)
+    return DistributedTrainer(
+        mesh, sampler, store, model, optax.sgd(1e-2),
+        local_batch=local_batch, seed_sharding="all",
+        logical_workers=workers, fault_plan=plan, **kw
+    )
+
+
+def drill_resize(topo, feat, labels, local_batch, seed):
+    """Kill at F, resume(mesh=F/2): trajectory + params bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import FaultPlan, Preemption
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    F = jax.device_count()
+    if F % 2 or F < 2:
+        common.log(
+            f"CHAOS resize SKIPPED ({F} devices; needs an even count >= 2)"
+        )
+        return
+    lab = jnp.asarray(labels)
+    mesh_hi = make_mesh(n_devices=F, data=1, feature=F)
+    idx = np.random.default_rng(seed).integers(
+        0, topo.node_count, 6 * local_batch * F
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer_a = _build_elastic_trainer(
+            topo, feat, mesh_hi, local_batch, F, checkpoint_dir=f"{tmp}/a",
+        )
+        seed_mat = trainer_a.pack_epoch(idx, seed=0)
+        key = jax.random.PRNGKey(7)
+        pa, oa = trainer_a.init(jax.random.PRNGKey(0))
+        pa, oa, losses_a = trainer_a.epoch_scan(pa, oa, seed_mat, lab, key)
+        losses_a = np.asarray(losses_a)
+
+        trainer_b = _build_elastic_trainer(
+            topo, feat, mesh_hi, local_batch, F, checkpoint_dir=f"{tmp}/b",
+            plan=FaultPlan(preempt_at_step=3),
+        )
+        p0, o0 = trainer_b.init(jax.random.PRNGKey(0))
+        try:
+            trainer_b.epoch_scan(p0, o0, seed_mat, lab, key)
+            raise AssertionError("FaultPlan preemption never fired")
+        except Preemption:
+            pass
+        mesh_lo = make_mesh(n_devices=F // 2, data=1, feature=F // 2)
+        pr, orr, key_r, step, epoch = trainer_b.resume(p0, o0, mesh=mesh_lo)
+        assert trainer_b.blocks_per_device == 2, \
+            f"blocks/device {trainer_b.blocks_per_device} != 2"
+        pr, orr, losses_r = trainer_b.epoch_scan(
+            pr, orr, seed_mat, lab, key_r, epoch=epoch, start_step=step
+        )
+        losses_r = np.asarray(losses_r)
+        assert np.array_equal(
+            losses_r.view(np.uint32), losses_a[step:].view(np.uint32)
+        ), "resized loss trajectory diverged from the full-mesh run"
+        assert _tree_equal(pa, pr), "resized final params diverged"
+        trainer_a.checkpointer.close()
+        trainer_b.checkpointer.close()
+    common.log(
+        f"CHAOS resize OK (killed at step 3 on F={F}, resumed at step "
+        f"{step} on F={F // 2}, {losses_r.shape[0]} remaining steps "
+        "bit-identical)"
+    )
+
+
+def drill_corrupt_checkpoint(topo, feat, labels, local_batch, seed):
+    """Flip manifest-covered bytes in the newest checkpoint: resume()
+    quarantines it (and a planted uncommitted dir) and falls back."""
+    import glob
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    lab = jnp.asarray(labels)
+    idx = np.random.default_rng(seed).integers(
+        0, topo.node_count, 6 * local_batch * jax.device_count()
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        ckdir = f"{tmp}/ck"
+        trainer = _build_trainer(
+            topo, feat, local_batch, checkpoint_dir=ckdir, checkpoint_every=2
+        )
+        seed_mat = trainer.pack_epoch(idx, seed=0)
+        key = jax.random.PRNGKey(7)
+        p0, o0 = trainer.init(jax.random.PRNGKey(0))
+        trainer.epoch_scan(p0, o0, seed_mat, lab, key)
+        trainer.checkpointer.wait_until_finished()
+        newest = trainer.checkpointer.latest_step()
+        prev_valid = trainer.checkpointer.all_steps()[-2]
+        # flip a manifest-covered byte in the newest payload
+        apath = os.path.join(ckdir, f"step-{newest}", "arrays.bin")
+        with open(apath, "r+b") as fh:
+            fh.seek(os.path.getsize(apath) // 2)
+            b = fh.read(1)
+            fh.seek(-1, os.SEEK_CUR)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        # plant an uncommitted partial directory "newer" than everything
+        partial = os.path.join(ckdir, f"step-{newest + 50}")
+        os.makedirs(partial)
+        with open(os.path.join(partial, "arrays.bin"), "wb") as fh:
+            fh.write(b"\x00" * 16)  # no manifest, no COMMIT: a crashed save
+        assert trainer.checkpointer.latest_step() == newest, \
+            "uncommitted directory leaked into the step scan"
+        pr, orr, key_r, step, epoch = trainer.resume(p0, o0)
+        meta = trainer.checkpointer.metadata(prev_valid)
+        assert step == meta["step"], \
+            f"fell back to step {step}, expected {meta['step']}"
+        quarantined = glob.glob(os.path.join(ckdir, "quarantine-*"))
+        assert quarantined, "corrupt checkpoint was not quarantined"
+        # the run continues from the fallback without manual intervention
+        pr, orr, losses_r = trainer.epoch_scan(
+            pr, orr, seed_mat, lab, key_r, epoch=epoch, start_step=step
+        )
+        assert np.isfinite(np.asarray(losses_r)).all()
+        trainer.checkpointer.close()
+    common.log(
+        f"CHAOS corrupt-checkpoint OK (newest checkpoint poisoned + "
+        f"partial dir planted; auto-fell-back to step {step}, "
+        f"{np.asarray(losses_r).shape[0]} steps completed after)"
+    )
+
+
+def drill_cold_outage(topo, feat, labels, local_batch, seed):
+    """Cold-tier outage: the circuit breaker serves fallback rows, the
+    epoch completes, degraded_lookups > 0, breaker closes after."""
+    import jax
+    import optax
+
+    from quiver_tpu import (
+        DegradedFeature,
+        FaultPlan,
+        Feature,
+        GraphSageSampler,
+    )
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.obs.registry import DEGRADED_LOOKUPS
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DataParallelTrainer
+
+    mesh = make_mesh()  # data = all devices, feature = 1
+    store = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    # outage: 6 consecutive lookup failures starting at lookup 3 (the
+    # init lookup is 0); breaker opens after 3, probes every 2 calls
+    plan = FaultPlan(feature_faults={3: 6})
+    degraded = DegradedFeature(
+        plan.wrap_feature(store), failures=3, probe_every=2,
+        fallback="zeros",
+    )
+    sampler = GraphSageSampler(
+        topo, [5, 5], seed=3, seed_capacity=local_batch
+    )
+    trainer = DataParallelTrainer(
+        mesh, sampler, degraded,
+        GraphSAGE(hidden=16, num_classes=4, num_layers=2),
+        optax.sgd(1e-2), local_batch=local_batch, prefetch_retries=3,
+        prefetch_backoff=1e-3,
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    idx = np.random.default_rng(seed).integers(
+        0, topo.node_count, 10 * trainer.global_batch
+    )
+    params, opt, mean_loss, steps = trainer.train_epoch(
+        params, opt, idx, np.asarray(labels), jax.random.PRNGKey(1)
+    )
+    assert steps == 10, f"epoch delivered {steps}/10 steps"
+    assert np.isfinite(mean_loss), "degraded epoch produced NaN mean loss"
+    served = int(np.asarray(degraded.metrics.value(DEGRADED_LOOKUPS)))
+    assert served > 0 and degraded.degraded_total == served, \
+        f"degraded_lookups {served} (expected > 0)"
+    assert degraded.breaker.state == "closed", \
+        f"breaker ended {degraded.breaker.state!r} (outage was finite)"
+    common.write_metrics(degraded, trainer, drill="chaos-cold-outage")
+    common.log(
+        f"CHAOS cold-outage OK ({served} lookups served degraded, epoch "
+        f"completed {steps}/10 steps, breaker closed after the outage)"
+    )
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=2000)
@@ -209,6 +435,8 @@ def main():
     p.add_argument("--local-batch", type=int, default=16)
     p.add_argument("--retry-steps", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--drills", nargs="*", default=None, choices=DRILLS,
+                   help="subset of drills to run (default: all)")
     p.add_argument("--smoke", action="store_true",
                    help="shrink the drills further (rehearsal mode)")
     args = p.parse_args()
@@ -220,14 +448,28 @@ def main():
     topo, feat, labels = _build_graph(
         args.nodes, args.feature_dim, args.seed
     )
+    selected = tuple(args.drills) if args.drills else DRILLS
 
     def body():
-        drill_guard(topo, feat, labels, args.local_batch, args.seed)
-        drill_retry(topo, args.retry_steps, args.local_batch, args.seed)
-        drill_preempt_resume(
-            topo, feat, labels, args.local_batch, args.seed
-        )
-        common.log("CHAOS all drills passed")
+        if "guard" in selected:
+            drill_guard(topo, feat, labels, args.local_batch, args.seed)
+        if "retry" in selected:
+            drill_retry(topo, args.retry_steps, args.local_batch, args.seed)
+        if "preempt" in selected:
+            drill_preempt_resume(
+                topo, feat, labels, args.local_batch, args.seed
+            )
+        if "resize" in selected:
+            drill_resize(topo, feat, labels, args.local_batch, args.seed)
+        if "corrupt" in selected:
+            drill_corrupt_checkpoint(
+                topo, feat, labels, args.local_batch, args.seed
+            )
+        if "cold-outage" in selected:
+            drill_cold_outage(
+                topo, feat, labels, args.local_batch, args.seed
+            )
+        common.log(f"CHAOS all drills passed ({', '.join(selected)})")
         return 0
 
     return common.run_guarded(body, args)

@@ -119,7 +119,8 @@ def main(argv=None):
     )
     p.add_argument(
         "--save-dir", default=None,
-        help="checkpoint directory (orbax Checkpointer): training resumes "
+        help="checkpoint directory (atomic manifest Checkpointer): "
+        "training resumes "
         "from the latest checkpoint there and saves each epoch — the "
         "checkpoint/resume capability the reference has none of",
     )

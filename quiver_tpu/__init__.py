@@ -34,7 +34,14 @@ from .obs import (
     StepTimeline,
     profile_epoch,
 )
-from .resilience import FaultPlan, Preemption, TransientFault
+from .resilience import (
+    CircuitBreaker,
+    CorruptCheckpoint,
+    DegradedFeature,
+    FaultPlan,
+    Preemption,
+    TransientFault,
+)
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .utils.debug import show_tensor_info, tensor_info
@@ -83,8 +90,7 @@ __all__ = [
     "reorder_by_degree",
     "show_tensor_info",
     "tensor_info",
-    # "Checkpointer" is reachable via lazy __getattr__ but kept out of
-    # __all__: star-import must not require the optional [checkpoint] extra
+    "Checkpointer",
     "Timer",
     "trace_scope",
     "enable_trace",
@@ -96,14 +102,18 @@ __all__ = [
     "FaultPlan",
     "Preemption",
     "TransientFault",
+    "CircuitBreaker",
+    "CorruptCheckpoint",
+    "DegradedFeature",
 ]
 
 __version__ = "0.1.0"
 
 
 def __getattr__(name):
-    # orbax-checkpoint is an optional extra (pyproject [checkpoint]); resolve
-    # Checkpointer lazily so base installs can import the package without it
+    # Checkpointer stays a lazy resolve (historical import-shape parity:
+    # the store was once orbax-backed and optional; it is self-contained
+    # now, but call sites import it both ways)
     if name == "Checkpointer":
         from .utils.checkpoint import Checkpointer
 

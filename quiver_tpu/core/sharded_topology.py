@@ -65,6 +65,10 @@ class ShardedTopology:
     def __init__(self, mesh, csr_topo: CSRTopo, axis: str = FEATURE_AXIS):
         self.mesh = mesh
         self.axis = axis
+        # retained for replan(): an elastic resume re-partitions the SAME
+        # host CSR onto a differently-shaped mesh (the arrays are already
+        # host-resident on the CSRTopo — this is a reference, not a copy)
+        self.csr_topo = csr_topo
         F = int(mesh.shape[axis])
         indptr = np.asarray(csr_topo.indptr, dtype=np.int64)
         indices = np.asarray(csr_topo.indices)
@@ -123,6 +127,17 @@ class ShardedTopology:
             "%.2f MB replicated (%.1fx shrink)",
             n, F, axis, rps, E_pad, E, per_chip / 2**20,
             replicated / 2**20, self.plan["shrink_factor"],
+        )
+
+    def replan(self, mesh, axis: str | None = None) -> "ShardedTopology":
+        """Re-partition the same host CSR onto a different mesh (elastic
+        resume: preemption handed back a different device count). Returns
+        a FRESH partition — new ``rows_per_shard``/owner map/``plan`` at
+        the new axis size; node and edge data are untouched, so sampling
+        results stay bit-identical (the PR 3 parity contract: routing
+        decides which wires the bits cross, never the bits)."""
+        return ShardedTopology(
+            mesh, self.csr_topo, axis=self.axis if axis is None else axis
         )
 
     def owner_of(self, ids):

@@ -661,6 +661,80 @@ class ShardedFeature(KernelChoice):
         # them against the new one
         self.last_tier_hits = None
 
+    def replan(self, mesh: Mesh) -> "ShardedFeature":
+        """Re-place the three-tier store onto a DIFFERENT mesh shape
+        (elastic resume: a run checkpointed at F=8 continuing at F=4).
+
+        The translated row space is reused verbatim — ``feature_order``,
+        the per-row dequant ``scale``, and every row's bytes are
+        unchanged; only the tier boundaries are re-planned for the new
+        feature-axis size (the same per-device byte budgets buy fewer
+        total sharded rows on a smaller mesh, so rows spill from L1 to
+        the cold tier) and the tiers are re-placed. Gathers therefore
+        stay bit-identical: the same rows come back, possibly over a
+        different comm path — the same exactness contract as
+        :meth:`resplit`. Compiled consumers must rebuild (their mesh
+        changed, not just their shapes).
+        """
+        if self.shape is None:
+            raise ValueError("replan() before from_cpu_tensor()")
+        n, f = self.shape
+        num_shards = int(mesh.shape[self.axis])
+        quantized = (
+            self.storage_dtype is not None
+            and self.storage_dtype == np.dtype(np.int8)
+        )
+        # reassemble the full translated row space on host: device region
+        # (retained host copy when available, else read back) + cold rows
+        if self._region_host is not None:
+            region = self._region_host
+        else:
+            parts = []
+            if self.rep is not None:
+                parts.append(np.asarray(self.rep))
+            if self.hot is not None:
+                parts.append(np.asarray(self.hot.table)[: self.hot_rows])
+            region = (
+                np.concatenate(parts) if len(parts) > 1
+                else parts[0] if parts
+                else np.zeros((0, f), self.dtype)
+            )
+        if self.cold is not None:
+            full = np.concatenate([region, np.asarray(self.cold)])
+        else:
+            full = region
+        rep_rows, hot_rows = self._plan_split(
+            n, f, np.dtype(self.dtype).itemsize, quantized, num_shards
+        )
+        device_rows = rep_rows + hot_rows
+        old_shards = self.mesh.shape[self.axis]
+        self.mesh = mesh
+        if self.cold is not None and hasattr(self.cold, "delete"):
+            self.cold.delete()
+        self.cold = None
+        self._cold_is_host = False
+        self._rep_ceiling_rows = rep_rows
+        self._place_region(full[:device_rows], rep_rows)
+        if device_rows < n:
+            self.cold, self._cold_is_host = to_pinned_host(
+                full[device_rows:], mesh=mesh
+            )
+        self._region_host = (
+            np.ascontiguousarray(full[:device_rows])
+            if (self.auto_split or self.replicate_budget > 0)
+            else None
+        )
+        # stale hits describe the OLD mesh's tiers
+        self.last_tier_hits = None
+        get_logger("feature").info(
+            "feature replan: %d -> %d shards on mesh axis '%s'; tiers now "
+            "%d replicated / %d sharded / %d cold rows (same translated "
+            "order — gathers stay bit-identical)",
+            old_shards, num_shards, self.axis,
+            rep_rows, self.hot_rows, n - device_rows,
+        )
+        return self
+
     def resplit_budget(self, replicate_budget: int | str) -> None:
         """:meth:`resplit` with the boundary given in bytes/device (same
         parser as ``device_cache_size``). Raises the L0 ceiling the

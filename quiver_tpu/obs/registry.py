@@ -50,6 +50,9 @@ __all__ = [
     "SAMPLE_OVERFLOW",
     "GUARD_SKIPPED",
     "GUARD_NONFINITE",
+    "PREFETCH_RETRIES",
+    "PREFETCH_SKIPS",
+    "DEGRADED_LOOKUPS",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -62,6 +65,13 @@ SAMPLE_OVERFLOW = "sample.hop_overflow"
 # mesh-total count of non-finite loss/grad values it detected
 GUARD_SKIPPED = "resilience.skipped_steps"
 GUARD_NONFINITE = "resilience.nonfinite_grads"
+# host-side resilience counters: prefetcher batch re-dispatches and
+# dropped batches (pipeline health next to resilience.skipped_steps in
+# metrics_report), and feature lookups served degraded by the cold-tier
+# circuit breaker's fallback instead of crashing the step
+PREFETCH_RETRIES = "prefetch.retries"
+PREFETCH_SKIPS = "prefetch.skipped_batches"
+DEGRADED_LOOKUPS = "resilience.degraded_lookups"
 
 _KINDS = ("counter", "gauge")
 

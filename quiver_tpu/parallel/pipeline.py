@@ -32,6 +32,10 @@ import random
 import time
 from typing import Callable, Iterable, Iterator, NamedTuple
 
+import numpy as np
+
+from ..obs.registry import PREFETCH_RETRIES, PREFETCH_SKIPS
+
 __all__ = ["Batch", "Prefetcher"]
 
 _SKIP_POLICIES = ("raise", "skip")
@@ -82,6 +86,12 @@ class Prefetcher:
         ``prefetch.dispatch`` (successful dispatch wall time),
         ``prefetch.retry_wait`` (each backoff sleep), ``prefetch.skip``
         (each dropped batch).
+      metrics: optional graftscope ``MetricsRegistry`` to land the
+        lifetime retry/skip COUNTERS on (``prefetch.retries``,
+        ``prefetch.skipped_batches``) — pass a trainer's registry and
+        ``metrics_report()`` shows pipeline health alongside
+        ``resilience.skipped_steps``. The timeline gets per-event
+        timings; the registry gets the running totals.
       retry_seed: seed for the jitter PRNG.
 
     ``retries_total`` / ``skips_total`` count across the prefetcher's
@@ -103,6 +113,7 @@ class Prefetcher:
         jitter: float = 0.5,
         skip_policy: str = "raise",
         timeline=None,
+        metrics=None,
         retry_seed: int = 0,
     ):
         if depth < 1:
@@ -129,6 +140,18 @@ class Prefetcher:
         self.jitter = float(jitter)
         self.skip_policy = skip_policy
         self.timeline = timeline
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.counter(
+                PREFETCH_RETRIES, unit="dispatches",
+                doc="prefetch batch re-dispatches after a raising "
+                    "sample/gather/transform (lifetime total)",
+            )
+            metrics.counter(
+                PREFETCH_SKIPS, unit="batches",
+                doc="poisoned batches dropped after retries exhausted "
+                    "(skip_policy='skip'; lifetime total)",
+            )
         self._jitter_rng = random.Random(retry_seed)
         self.retries_total = 0
         self.skips_total = 0
@@ -136,6 +159,13 @@ class Prefetcher:
     def _observe(self, stage: str, seconds: float) -> None:
         if self.timeline is not None:
             self.timeline.observe(stage, seconds)
+
+    def _publish_counters(self) -> None:
+        """Land the running totals on the registry (host write from the
+        single worker thread — same thread that increments them)."""
+        if self.metrics is not None:
+            self.metrics.set(PREFETCH_RETRIES, np.int32(self.retries_total))
+            self.metrics.set(PREFETCH_SKIPS, np.int32(self.skips_total))
 
     def _dispatch(self, seeds) -> Batch:
         out = self.sampler.sample(seeds)
@@ -156,6 +186,7 @@ class Prefetcher:
                     if self.skip_policy == "skip":
                         self.skips_total += 1
                         self._observe("prefetch.skip", 0.0)
+                        self._publish_counters()
                         from ..utils.trace import get_logger
 
                         get_logger().warning(
@@ -168,6 +199,7 @@ class Prefetcher:
                     raise
                 attempt += 1
                 self.retries_total += 1
+                self._publish_counters()
                 delay = min(
                     self.backoff * 2.0 ** (attempt - 1), self.backoff_cap
                 ) * (1.0 + self.jitter * self._jitter_rng.random())

@@ -48,6 +48,7 @@ from ..obs.registry import (
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
+from ..resilience.elastic import validate_resume_meta, worker_ordered_mean
 from ..resilience.faults import Preemption
 from ..resilience.guard import guard_verdict, guarded_update
 from ..utils.trace import info_once
@@ -57,6 +58,37 @@ from ..parallel.train import cross_entropy_on_seeds
 from ..sampling.sampler import Adj, GraphSageSampler, multilayer_sample
 
 __all__ = ["DistributedTrainer", "DataParallelTrainer"]
+
+
+def _metrics_report(metrics: MetricsRegistry, timeline: StepTimeline,
+                    empty_note: str = "") -> str:
+    """Shared one-call telemetry summary: every recorded registry metric
+    (totals + the most recent per-step value) plus the host StepTimeline's
+    streaming percentiles."""
+    lines = []
+    snaps = metrics.snapshots()
+    if snaps:
+        lines.append("metrics:")
+        for s in snaps:
+            arr = s.numpy
+            head = f"  {s.name} ({s.kind}"
+            if s.steps is not None:
+                head += f", {s.steps} steps"
+            head += ")"
+            if s.kind == "counter":
+                head += f": total={int(arr.sum())}"
+                if s.steps is not None:
+                    head += f" last={np.asarray(s.last()).tolist()}"
+            else:
+                head += f": last={np.asarray(s.last()).tolist()}"
+                if s.steps is not None:
+                    head += f" total={arr.sum(axis=0).tolist()}"
+            lines.append(head)
+    else:
+        lines.append(f"metrics: (none recorded{empty_note})")
+    lines.append("timeline:")
+    lines.extend("  " + ln for ln in timeline.report().splitlines())
+    return "\n".join(lines)
 
 
 class DistributedTrainer:
@@ -84,9 +116,22 @@ class DistributedTrainer:
         (in-program NaN feature rows at planned steps, simulated
         preemption); None = no injection compiled in.
       checkpoint_dir / checkpoint_every / checkpoint_keep: enable async
-        orbax checkpointing — epoch_scan saves (params, opt_state, step,
-        PRNG key) every ``checkpoint_every`` steps (between scan chunks),
-        keeping ``checkpoint_keep`` checkpoints; see :meth:`resume`.
+        checkpointing (utils/checkpoint.py: atomic manifest-based saves
+        with per-array checksums) — epoch_scan saves (params, opt_state,
+        step, PRNG key) every ``checkpoint_every`` steps (between scan
+        chunks), keeping ``checkpoint_keep`` checkpoints; see
+        :meth:`resume`.
+      logical_workers: pin the LOGICAL seed-block worker count
+        independently of the mesh (elastic mode; requires
+        ``seed_sharding="all"`` and a multiple of the device count). Each
+        device then runs ``logical_workers / devices`` blocks per step
+        with the per-block PRNG key folded on the logical worker index,
+        and the gradient/loss mean reduces in fixed logical-worker order
+        (``resilience.elastic.worker_ordered_mean``) — the trajectory
+        becomes bitwise independent of the mesh shape, which is what lets
+        ``resume(mesh=)`` continue a run checkpointed at F=8 on an F=4
+        mesh bit-identically. None (default) = one block per device with
+        the plain pmean reduction (the non-elastic fast path).
     """
 
     def __init__(
@@ -107,6 +152,7 @@ class DistributedTrainer:
         checkpoint_dir=None,
         checkpoint_every: int = 0,
         checkpoint_keep: int = 3,
+        logical_workers: int | None = None,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -217,7 +263,7 @@ class DistributedTrainer:
         self._fault_step = 0  # eager step() call counter the plan indexes
         self._preempt_fired = False
         # checkpoint/auto-resume: checkpoint_dir= + checkpoint_every=
-        # drive async orbax saves of (params, opt_state, step, PRNG key)
+        # drive async atomic saves of (params, opt_state, step, PRNG key)
         # between scan chunks; resume() restores the latest and the
         # caller replays the packed seed stream from the saved step
         # (bit-identical trajectory — pack_epoch is deterministic per
@@ -317,10 +363,34 @@ class DistributedTrainer:
         self.data_size = mesh.shape[DATA_AXIS]
         self.feature_size = mesh.shape[FEATURE_AXIS]
         # seed-block workers: every device under "all", one per data group
-        # under "data"
-        self.workers = self.data_size * (
+        # under "data". Elastic mode (logical_workers=) decouples the
+        # LOGICAL worker count from the mesh: seed packing, the per-block
+        # PRNG fold-in, and the fixed-order gradient reduction all follow
+        # the logical count, so the same run continues bit-identically on
+        # a differently-shaped mesh (resume(mesh=)).
+        self._device_workers = self.data_size * (
             self.feature_size if self.seed_sharding == "all" else 1
         )
+        self.elastic = logical_workers is not None
+        if self.elastic:
+            lw = int(logical_workers)
+            if self.seed_sharding != "all":
+                raise ValueError(
+                    "logical_workers= (elastic mode) requires "
+                    "seed_sharding='all': every device must be a full "
+                    "seed-block worker for blocks to re-map across mesh "
+                    "shapes"
+                )
+            if lw < self._device_workers or lw % self._device_workers:
+                raise ValueError(
+                    f"logical_workers={lw} must be a multiple of the "
+                    f"device worker count {self._device_workers} (each "
+                    f"device runs logical_workers/devices seed blocks)"
+                )
+            self.workers = lw
+        else:
+            self.workers = self._device_workers
+        self.blocks_per_device = self.workers // self._device_workers
         self.global_batch = self.local_batch * self.workers
         _, self.caps = sampler._compiled(self.local_batch)
         self._step = self._build()
@@ -359,36 +429,10 @@ class DistributedTrainer:
         """One-call text summary of the trainer's telemetry: every recorded
         registry metric (totals + the most recent per-step value) plus the
         host StepTimeline's streaming percentiles."""
-        lines = []
-        snaps = self.metrics.snapshots()
-        if snaps:
-            lines.append("metrics:")
-            for s in snaps:
-                arr = s.numpy
-                head = f"  {s.name} ({s.kind}"
-                if s.steps is not None:
-                    head += f", {s.steps} steps"
-                head += ")"
-                if s.kind == "counter":
-                    head += f": total={int(arr.sum())}"
-                    if s.steps is not None:
-                        head += f" last={np.asarray(s.last()).tolist()}"
-                else:
-                    head += f": last={np.asarray(s.last()).tolist()}"
-                    if s.steps is not None:
-                        head += f" total={arr.sum(axis=0).tolist()}"
-                lines.append(head)
-        else:
-            lines.append(
-                "metrics: (none recorded"
-                + ("" if self.collect_metrics else "; collect_metrics=False")
-                + ")"
-            )
-        lines.append("timeline:")
-        lines.extend(
-            "  " + ln for ln in self.timeline.report().splitlines()
+        return _metrics_report(
+            self.metrics, self.timeline,
+            "" if self.collect_metrics else "; collect_metrics=False",
         )
-        return "\n".join(lines)
 
     # -- program ------------------------------------------------------------
 
@@ -523,16 +567,14 @@ class DistributedTrainer:
             )
             return x, ov_box[0], hits
 
-        def body(params, opt_state, topo, parts, seeds, labels, key, inject):
-            # distinct key per seed-block worker; under "data" sharding the
-            # feature-axis members share the key (identical redundant
-            # sampling); separate streams for sampling vs dropout
-            widx = jax.lax.axis_index(DATA_AXIS)
-            if routed:
-                widx = widx * mesh.shape[FEATURE_AXIS] + jax.lax.axis_index(
-                    FEATURE_AXIS
-                )
-            key = jax.random.fold_in(key, widx)
+        elastic = self.elastic
+        bpd = self.blocks_per_device
+        workers = self.workers
+
+        def one_block(params, topo, parts, seeds, labels, key, inject):
+            # one logical seed block: sample + gather + loss/grad. ``key``
+            # arrives already folded on the block's LOGICAL worker index;
+            # separate streams for sampling vs dropout
             sample_key, dropout_key = jax.random.split(key)
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
             if topo_sharded:
@@ -581,13 +623,61 @@ class DistributedTrainer:
                 return cross_entropy_on_seeds(logits[: seeds.shape[0]], lab, mask)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
+            return loss, grads, routed_ov, tier_hits, sample_ov
+
+        def body(params, opt_state, topo, parts, seeds, labels, key, inject):
+            # distinct key per seed-block worker; under "data" sharding the
+            # feature-axis members share the key (identical redundant
+            # sampling)
+            widx = jax.lax.axis_index(DATA_AXIS)
+            if routed:
+                widx = widx * mesh.shape[FEATURE_AXIS] + jax.lax.axis_index(
+                    FEATURE_AXIS
+                )
             axes = (DATA_AXIS, FEATURE_AXIS)
-            if guard:
-                # verdict BEFORE the pmean (it spreads one worker's NaN
-                # mesh-wide); psum'd over both axes so every chip agrees
-                ok, local_bad = guard_verdict(loss, grads, axes)
-            grads = jax.lax.pmean(grads, axes)
-            loss = jax.lax.pmean(loss, axes)
+            if not elastic:
+                loss, grads, routed_ov, tier_hits, sample_ov = one_block(
+                    params, topo, parts, seeds, labels,
+                    jax.random.fold_in(key, widx), inject
+                )
+                if guard:
+                    # verdict BEFORE the pmean (it spreads one worker's NaN
+                    # mesh-wide); psum'd over both axes so every chip agrees
+                    ok, local_bad = guard_verdict(loss, grads, axes)
+                grads = jax.lax.pmean(grads, axes)
+                loss = jax.lax.pmean(loss, axes)
+            else:
+                # elastic mode: this device runs ``bpd`` logical seed
+                # blocks sequentially (every device runs the same
+                # per-block program, so the per-block collectives stay
+                # uniform and deadlock-free), each keyed on its LOGICAL
+                # worker index — at bpd=1 the keys equal the non-elastic
+                # fold exactly. The mean then reduces in fixed logical-
+                # worker order (all_gather is device-major, blocks-minor
+                # = worker order), making loss/grads bitwise independent
+                # of how many devices the workers map onto: the seam
+                # resume(mesh=) relies on.
+                blocks = seeds.reshape(bpd, -1)
+                outs = [
+                    one_block(
+                        params, topo, parts, blocks[b], labels,
+                        jax.random.fold_in(key, widx * bpd + b), inject
+                    )
+                    for b in range(bpd)
+                ]
+                losses = jnp.stack([o[0] for o in outs])
+                grads_blocks = jax.tree_util.tree_map(
+                    lambda *g: jnp.stack(g), *[o[1] for o in outs]
+                )
+                routed_ov = sum(o[2] for o in outs)
+                tier_hits = sum(o[3] for o in outs)
+                sample_ov = sum(o[4] for o in outs)
+                if guard:
+                    # stacked per-block values: one verdict for the whole
+                    # step, still counted before any cross-worker mean
+                    ok, local_bad = guard_verdict(losses, grads_blocks, axes)
+                grads = worker_ordered_mean(grads_blocks, axes, workers)
+                loss = worker_ordered_mean(losses, axes, workers)
             # graftscope: the step's telemetry rides ONE metrics pytree.
             # Each metric declares its own mesh reduction (applied once by
             # tape.finalize): the routed overflow and per-hop sample
@@ -883,7 +973,10 @@ class DistributedTrainer:
                         f"(last checkpoint at step {lo})"
                     )
                 if self.checkpointer is not None:
-                    self._save_checkpoint(params, opt_state, key, epoch, hi)
+                    self._save_checkpoint(
+                        params, opt_state, key, epoch, hi,
+                        steps_per_epoch=steps,
+                    )
                 lo = hi
         if len(losses_parts) == 1:
             losses, mtrees = losses_parts[0], mtrees_parts[0]
@@ -900,10 +993,16 @@ class DistributedTrainer:
 
     # -- checkpoint / auto-resume -------------------------------------------
 
-    def _save_checkpoint(self, params, opt_state, key, epoch, step) -> None:
-        """Async orbax save between scan chunks. ``step`` counts completed
+    def _save_checkpoint(self, params, opt_state, key, epoch, step,
+                         steps_per_epoch: int | None = None) -> None:
+        """Async atomic save between scan chunks. ``step`` counts completed
         rows of the CURRENT epoch's packed seed matrix; ``key`` is the
-        epoch's key0 (stored as raw key data — restore re-splits it)."""
+        epoch's key0 (stored as raw key data — restore re-splits it). The
+        manifest metadata records the writer's mesh shape, logical worker
+        count, and epoch geometry — what :meth:`resume` validates before
+        trusting the state (and what makes the checkpoint
+        topology-PORTABLE: an elastic resume onto a different mesh shape
+        checks the logical facts, not the device layout)."""
         if hasattr(key, "dtype") and jnp.issubdtype(
                 key.dtype, jax.dtypes.prng_key):
             key_data = jax.random.key_data(key)
@@ -912,23 +1011,63 @@ class DistributedTrainer:
         state = {
             "params": params,
             "opt_state": opt_state,
-            # 0-d ndarrays, not numpy scalars — orbax's StandardSave
-            # rejects bare np.int32 scalar types
             "step": np.asarray(step, np.int32),
             "epoch": np.asarray(epoch, np.int32),
             "key": key_data,
         }
-        self.checkpointer.save(self._ckpt_seq, state)
+        meta = {
+            "mesh": {DATA_AXIS: int(self.data_size),
+                     FEATURE_AXIS: int(self.feature_size)},
+            "workers": int(self.workers),
+            "local_batch": int(self.local_batch),
+            "seed_sharding": self.seed_sharding,
+            "elastic": bool(self.elastic),
+            "epoch": int(epoch),
+            "step": int(step),
+        }
+        if steps_per_epoch is not None:
+            meta["steps_per_epoch"] = int(steps_per_epoch)
+        self.checkpointer.save(self._ckpt_seq, state, metadata=meta)
         self._ckpt_seq += 1
 
-    def resume(self, params, opt_state):
-        """Restore the latest checkpoint, if any.
+    def resume(self, params, opt_state, mesh: Mesh | None = None,
+               checkpoint_step: int | None = None):
+        """Restore the newest VALID checkpoint, if any.
+
+        ``checkpoint_step`` pins a specific checkpoint (the
+        checkpointer's sequence id, see ``all_steps()``) instead of the
+        newest valid one — e.g. rolling back past a bad data batch; a
+        pinned checkpoint that fails verification raises
+        ``CorruptCheckpoint`` instead of falling back.
 
         Returns ``(params, opt_state, key, step, epoch)`` — the restored
         train state, the saved epoch key0 (raw key data; feed it straight
         back to :meth:`epoch_scan`), and where training stopped. With no
         checkpoint on disk the inputs pass through with
         ``(key=None, step=0, epoch=0)``.
+
+        Integrity: the checkpointer verifies per-array checksums and the
+        COMMIT marker — a corrupt or half-written newest checkpoint is
+        quarantined (one log line) and the newest VALID one restores
+        instead; nothing resumes from garbage. The manifest metadata is
+        then validated against this trainer: a logical-worker /
+        local_batch mismatch, a restored step outside the saved epoch's
+        ``steps_per_epoch``, or a mesh-shape change without the elastic
+        opt-in below all raise instead of silently training a different
+        run.
+
+        **Elastic resume** (``mesh=``): restore onto a DIFFERENT mesh
+        shape — preemption handed back a smaller slice. Requires the
+        writing trainer to have pinned ``logical_workers=`` (the
+        fixed-order reduction is what makes the trajectory mesh-shape
+        independent). The trainer re-plans in place: the sharded topology
+        and the three-tier feature store re-partition onto the new mesh
+        via their ``replan`` seams, the step/epoch programs rebuild, and
+        each device picks up ``logical_workers / devices`` seed blocks.
+        A trainer freshly CONSTRUCTED on the new mesh (the real
+        process-death flow) passes its own mesh explicitly —
+        ``resume(mesh=trainer.mesh)`` — as the opt-in acknowledgment that
+        the shape changed.
 
         To reproduce the uninterrupted run bit-identically, regenerate
         the SAME packed seed matrix (``pack_epoch`` with the same seed —
@@ -944,12 +1083,34 @@ class DistributedTrainer:
                 "(checkpoint_dir=/checkpoint_every= at construction)"
             )
         self.checkpointer.wait_until_finished()
-        latest = self.checkpointer.latest_step()
-        if latest is None:
-            return params, opt_state, None, 0, 0
-        # restore INTO the caller's freshly-initialized state as the
-        # template: an untemplated orbax restore turns tuples into lists,
-        # which breaks the scan carry's pytree structure downstream
+        if checkpoint_step is None:
+            latest = self.checkpointer.latest_valid_step()
+            if latest is None:
+                return params, opt_state, None, 0, 0
+        else:
+            latest = int(checkpoint_step)
+        meta = self.checkpointer.metadata(latest)
+        target = self.mesh if mesh is None else mesh
+        target_shape = {DATA_AXIS: int(target.shape[DATA_AXIS]),
+                        FEATURE_AXIS: int(target.shape[FEATURE_AXIS])}
+        saved_mesh = meta.get("mesh")
+        if (saved_mesh is not None and mesh is None
+                and dict(saved_mesh) != target_shape):
+            # satellite guard: the old path device_put a foreign-mesh
+            # checkpoint blindly; a shape change must be an explicit
+            # elastic opt-in
+            raise ValueError(
+                f"checkpoint was written on mesh {dict(saved_mesh)} but "
+                f"this trainer's mesh is {target_shape}; pass "
+                f"resume(mesh=) to opt into the elastic restore (requires "
+                f"logical_workers= on the writing trainer)"
+            )
+        validate_resume_meta(
+            meta, mesh_shape=target_shape, workers=self.workers,
+            local_batch=self.local_batch,
+        )
+        if mesh is not None and mesh is not self.mesh:
+            self._replan(mesh)
         template = {
             "params": params,
             "opt_state": opt_state,
@@ -958,16 +1119,74 @@ class DistributedTrainer:
             "key": np.zeros((2,), np.uint32),  # threefry2x32 key data
         }
         state = self.checkpointer.restore(latest, template=template)
-        # orbax commits restored arrays to one device; the step program
-        # wants them mesh-replicated (in_spec P()) — re-anchor explicitly
+        step = int(np.asarray(state["step"]))
+        spe = meta.get("steps_per_epoch")
+        if spe is not None and not 0 <= step <= int(spe):
+            raise ValueError(
+                f"restored step {step} is outside [0, {int(spe)}] for the "
+                f"saved epoch — the checkpoint directory does not belong "
+                f"to this run's seed packing"
+            )
+        # the restore hands back global host arrays; the step program
+        # wants them mesh-replicated (in_spec P()) — anchor explicitly
         rep = NamedSharding(self.mesh, P())
         return (
             jax.device_put(state["params"], rep),
             jax.device_put(state["opt_state"], rep),
             jnp.asarray(np.asarray(state["key"])),
-            int(np.asarray(state["step"])),
+            step,
             int(np.asarray(state["epoch"])),
         )
+
+    def _replan(self, mesh: Mesh) -> None:
+        """Re-plan the trainer onto a new mesh shape (elastic resume).
+
+        The logical worker count is FIXED (seed packing, per-block keys,
+        and the fixed-order reduction all follow it); what changes is how
+        many blocks each device runs. The sharded topology, the sharded
+        feature store, and the sampler re-partition via their ``replan``
+        seams — same bytes, new owners — and the compiled step/epoch
+        programs rebuild against the new mesh.
+        """
+        if not self.elastic:
+            raise ValueError(
+                "resume(mesh=) needs an elastic trainer: construct with "
+                "logical_workers=<the writing run's worker count> so the "
+                "step reduction is mesh-shape independent"
+            )
+        dev_workers = int(mesh.shape[DATA_AXIS]) * int(
+            mesh.shape[FEATURE_AXIS]
+        )
+        if dev_workers < 1 or self.workers % dev_workers:
+            raise ValueError(
+                f"cannot re-plan {self.workers} logical workers onto "
+                f"{dev_workers} devices (must divide evenly)"
+            )
+        old = (int(self.data_size), int(self.feature_size))
+        self.mesh = mesh
+        self.data_size = mesh.shape[DATA_AXIS]
+        self.feature_size = mesh.shape[FEATURE_AXIS]
+        self._device_workers = dev_workers
+        self.blocks_per_device = self.workers // dev_workers
+        if self.topo_sharded:
+            self.sampler.replan(mesh)
+            self.topo = (self.sampler.topo.indptr, self.sampler.topo.indices)
+        else:
+            self.topo = self._mesh_wide_topo(self.sampler.topo)
+        if isinstance(self.feature, ShardedFeature):
+            self.feature.replan(mesh)
+        self._cold = self._mesh_wide_host(self.feature.cold) if getattr(
+            self.feature, "_cold_is_host", False) else self.feature.cold
+        info_once(
+            "trainer-elastic-replan",
+            "elastic replan: mesh (data=%d, feature=%d) -> (data=%d, "
+            "feature=%d); %d logical workers now run %d block(s)/device "
+            "(trajectory stays bit-identical — fixed-order reduction)",
+            old[0], old[1], int(self.data_size), int(self.feature_size),
+            self.workers, self.blocks_per_device,
+        )
+        self._step = self._build()
+        self._epoch_fn = self._build_epoch()
 
     # graftlint: eager -- between-batch tuner on host numpy telemetry; the
     def _maybe_grow_routed_alpha(self) -> None:  # step program never calls it
@@ -1041,6 +1260,9 @@ class DataParallelTrainer:
         model,
         tx: optax.GradientTransformation,
         local_batch: int = 128,
+        prefetch_retries: int = 0,
+        prefetch_backoff: float = 0.05,
+        prefetch_skip_policy: str = "raise",
     ):
         if isinstance(feature, ShardedFeature):
             raise ValueError(
@@ -1061,6 +1283,17 @@ class DataParallelTrainer:
         self.data_size = mesh.shape[DATA_AXIS]
         self.global_batch = self.local_batch * self.data_size
         self._step_cache = {}
+        # graftscope: the epoch loop's Prefetcher lands its retry/skip
+        # counters here, so pipeline health is readable next to the rest
+        # of the telemetry (metrics_report)
+        self.metrics = MetricsRegistry()
+        self.timeline = StepTimeline()
+        # resilience knobs forwarded to the epoch loop's Prefetcher
+        # (bounded retry + skip-and-count for transient host faults —
+        # see parallel/pipeline.py; defaults keep the fail-fast behavior)
+        self.prefetch_retries = int(prefetch_retries)
+        self.prefetch_backoff = float(prefetch_backoff)
+        self.prefetch_skip_policy = str(prefetch_skip_policy)
         self._pin_auto_caps()
 
     def _pin_auto_caps(self):
@@ -1166,6 +1399,11 @@ class DataParallelTrainer:
         return step
 
     # -- API ----------------------------------------------------------------
+
+    def metrics_report(self) -> str:
+        """One-call telemetry summary (prefetch retry/skip counters from
+        the epoch loop's Prefetcher + host stage timeline)."""
+        return _metrics_report(self.metrics, self.timeline)
 
     def init(self, rng):
         """Initialize params/opt_state from one sampled block."""
@@ -1277,7 +1515,13 @@ class DataParallelTrainer:
 
         losses = []
         group = []
-        for batch in Prefetcher(self.sampler, self.feature, depth=depth).run(blocks):
+        prefetcher = Prefetcher(
+            self.sampler, self.feature, depth=depth,
+            retries=self.prefetch_retries, backoff=self.prefetch_backoff,
+            skip_policy=self.prefetch_skip_policy,
+            timeline=self.timeline, metrics=self.metrics,
+        )
+        for batch in prefetcher.run(blocks):
             group.append(batch)
             if len(group) == self.data_size:
                 key, sub = jax.random.split(key)

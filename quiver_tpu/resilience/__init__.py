@@ -27,6 +27,24 @@ where its fault class occurs:
   retries with exponential backoff + deterministic jitter and can
   skip-and-count a poisoned batch after retries exhaust.
 
+The elastic layer (PR 7) extends the same defenses to faults that CHANGE
+the world instead of leaving it intact:
+
+* **Topology-portable, integrity-verified checkpoints**
+  (``integrity.py`` + ``utils/checkpoint.py``): saves are atomic (temp
+  dir + ``COMMIT`` marker + one rename) with a mesh-agnostic manifest of
+  per-array checksums; restore quarantines corrupt/partial checkpoints
+  and falls back to the newest valid one.
+* **Elastic resume** (``elastic.py`` + ``DistributedTrainer(
+  logical_workers=)`` / ``resume(mesh=)``): a run checkpointed at F=8
+  continues at F=4 — :func:`worker_ordered_mean` makes the step reduction
+  bitwise mesh-shape independent, and the sharded topology / three-tier
+  feature store re-partition via their ``replan`` seams.
+* **Degraded-mode feature serving** (``elastic.py``):
+  :class:`CircuitBreaker` + :class:`DegradedFeature` turn a cold-tier
+  OUTAGE into fallback rows (zeros/last-good) and a
+  ``resilience.degraded_lookups`` counter instead of a dead epoch.
+
 ``faults.py`` is the test substrate proving all of the above: a seeded,
 fully deterministic :class:`FaultPlan` that injects NaN rows into gathered
 features (in-program, step-indexed), transient exceptions into host
@@ -35,6 +53,12 @@ lane by benchmarks (``benchmarks/chaos.py``, the mega_session ``chaos``
 stage).
 """
 
+from .elastic import (
+    CircuitBreaker,
+    DegradedFeature,
+    validate_resume_meta,
+    worker_ordered_mean,
+)
 from .faults import (
     FaultPlan,
     FaultyFeature,
@@ -43,8 +67,12 @@ from .faults import (
     TransientFault,
 )
 from .guard import guard_verdict, guarded_update, nonfinite_count
+from .integrity import CorruptCheckpoint
 
 __all__ = [
+    "CircuitBreaker",
+    "CorruptCheckpoint",
+    "DegradedFeature",
     "FaultPlan",
     "FaultySampler",
     "FaultyFeature",
@@ -53,4 +81,6 @@ __all__ = [
     "guard_verdict",
     "guarded_update",
     "nonfinite_count",
+    "validate_resume_meta",
+    "worker_ordered_mean",
 ]

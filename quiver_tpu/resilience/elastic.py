@@ -1,0 +1,299 @@
+"""Elastic mesh resilience: continue a run on a different mesh shape, and
+keep serving features when the cold tier is down.
+
+Preemption at production scale routinely hands back a *smaller* slice than
+the one that died (ROADMAP north star; resize-and-continue is table stakes
+for scalable distributed GNN training — PAPERS.md, arxiv 2010.03166). Two
+facts make a bit-identical resize possible here:
+
+* PR 3's distributed sampler is bit-identical across topology shardings:
+  per seed block and PRNG key, the owner-routed sample equals the
+  replicated kernel's draw no matter how many shards answer it — so a
+  block sampled on an F=4 mesh reproduces the F=8 run's block exactly.
+* PR 6 pre-splits the epoch key stream globally (per-step keys are a
+  function of key0 and the FULL step count), so resume boundaries cannot
+  perturb the keys.
+
+What remains mesh-shape-dependent is the *reduction order* of the
+gradient/loss mean: ``pmean`` over 8 devices and ``pmean`` over 4 devices
+of locally-presummed pairs associate differently and drift in the last
+ulp. :func:`worker_ordered_mean` removes that dependence: per-block values
+are ``all_gather``-ed into LOGICAL WORKER order (the gather axis is
+device-major, blocks-minor — exactly ``worker = device * blocks_per_device
++ block``) and reduced in that fixed order, so the compiled reduction is
+byte-for-byte the same computation at every mesh shape. The
+``DistributedTrainer(logical_workers=)`` elastic mode builds its step on
+this reduction; ``resume(mesh=)`` then re-plans ``ShardedTopology`` /
+``ShardedFeature`` / the sampler onto the new mesh via their ``replan``
+seams and the remaining trajectory stays bit-identical
+(tests/test_resilience.py, benchmarks/chaos.py resize drill).
+
+The degraded-mode feature store lives here too: :class:`CircuitBreaker` +
+:class:`DegradedFeature` wrap host-side feature lookups (the Prefetcher /
+DataParallel path, where a cold-tier outage — flaky storage, a dead host
+— surfaces as raised lookups). Closed, failures propagate (bounded retry
+upstream owns transients); after ``failures`` consecutive failures the
+breaker opens and lookups serve a configurable fallback (zeros or
+last-good rows) instead of crashing the epoch, counted on the graftscope
+registry (``resilience.degraded_lookups``); half-open probes re-test the
+real store and close the breaker when the outage ends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.registry import DEGRADED_LOOKUPS, MetricsRegistry
+from ..utils.trace import get_logger
+
+__all__ = [
+    "CircuitBreaker",
+    "DegradedFeature",
+    "validate_resume_meta",
+    "worker_ordered_mean",
+]
+
+
+def worker_ordered_mean(tree, axes, workers: int):
+    """Mean over the logical-worker axis in a FIXED order — bitwise
+    independent of how the workers map onto devices.
+
+    Each leaf arrives as this device's ``(blocks_per_device, ...)`` stack
+    of per-block values. ``all_gather`` over ``axes`` (major-to-minor in
+    the mesh's axis order, matching the trainer's flat worker index)
+    produces the ``(workers, ...)`` array in logical worker order on every
+    device; the mean then reduces a tensor whose shape and content do not
+    depend on the mesh shape, so an F=8 run and an F=4 run of the same
+    logical workers produce bit-identical results. Call inside
+    ``shard_map`` with ``axes`` built from the ``parallel/mesh`` axis
+    constants.
+    """
+
+    def one(x):
+        g = jax.lax.all_gather(x, axes)
+        g = g.reshape((workers,) + tuple(x.shape[1:]))
+        # explicit left-fold, NOT jnp.mean: XLA rewrites a reduce over an
+        # all_gather'd axis into an all-reduce, whose reduction order is
+        # topology-dependent — exactly the mesh-shape dependence this
+        # function exists to remove. An unrolled chain of adds has one
+        # fixed association and survives as-is in both programs.
+        total = g[0]
+        for i in range(1, workers):
+            total = total + g[i]
+        return total / workers
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def validate_resume_meta(meta: dict, *, mesh_shape: dict, workers: int,
+                         local_batch: int) -> None:
+    """Validate a checkpoint manifest's ``meta`` against the trainer that
+    wants to restore it (the elastic-resume contract).
+
+    Raises ``ValueError`` naming the first mismatch: the logical worker
+    count and per-block batch size decide the seed packing and the
+    per-block PRNG fold-in, so a mismatch would not crash — it would
+    silently train a DIFFERENT run. Mesh-shape changes additionally
+    require the writer to have been elastic (``logical_workers=``): a
+    pmean-reduced trajectory is not reproducible on another shape.
+    """
+    saved_workers = meta.get("workers")
+    if saved_workers is not None and int(saved_workers) != int(workers):
+        raise ValueError(
+            f"checkpoint was written with {saved_workers} logical workers, "
+            f"this trainer runs {workers}; construct the trainer with "
+            f"logical_workers={saved_workers} (seed packing and per-block "
+            f"PRNG fold-in follow the logical worker count)"
+        )
+    saved_lb = meta.get("local_batch")
+    if saved_lb is not None and int(saved_lb) != int(local_batch):
+        raise ValueError(
+            f"checkpoint was written with local_batch={saved_lb}, this "
+            f"trainer uses {local_batch}; the per-block seed width must "
+            f"match for the packed seed matrix to replay"
+        )
+    saved_mesh = meta.get("mesh")
+    if saved_mesh is not None and dict(saved_mesh) != dict(mesh_shape):
+        if not meta.get("elastic"):
+            raise ValueError(
+                f"checkpoint was written on mesh {dict(saved_mesh)} by a "
+                f"NON-elastic trainer and cannot restore onto "
+                f"{dict(mesh_shape)}: only the logical_workers= step "
+                f"reduction is bit-reproducible across mesh shapes"
+            )
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed -> open -> half-open).
+
+    Deterministic by construction — state advances only on
+    :meth:`record_success` / :meth:`record_failure` and the
+    open -> half-open transition is COUNT-based (every ``probe_every``-th
+    short-circuited call lets one probe through), so chaos drills replay
+    exactly; no wall clock is consulted.
+
+    States:
+      * ``closed`` — every call attempts the real operation; failures
+        count consecutively and propagate to the caller.
+      * ``open`` — entered after ``failures`` consecutive failures (or a
+        failed probe): calls are short-circuited to the fallback.
+      * ``half-open`` — after ``probe_every`` short-circuited calls, one
+        probe attempts the real operation: success closes the breaker,
+        failure re-opens it.
+    """
+
+    def __init__(self, failures: int = 3, probe_every: int = 8):
+        if failures < 1 or probe_every < 1:
+            raise ValueError(
+                f"failures/probe_every must be >= 1, got "
+                f"{failures}/{probe_every}"
+            )
+        self.failures = int(failures)
+        self.probe_every = int(probe_every)
+        self.state = "closed"
+        self._consecutive = 0
+        self._since_probe = 0
+
+    def allow(self) -> bool:
+        """Should the caller attempt the real operation? Advances the
+        open-state probe countdown (transitioning to ``half-open`` when a
+        probe is due)."""
+        if self.state == "closed" or self.state == "half-open":
+            return True
+        self._since_probe += 1
+        if self._since_probe >= self.probe_every:
+            self._since_probe = 0
+            self.state = "half-open"
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self._consecutive = 0
+        if self.state != "closed":
+            get_logger("resilience").info(
+                "circuit breaker CLOSED (probe succeeded; outage over)"
+            )
+            self.state = "closed"
+
+    def record_failure(self) -> None:
+        self._consecutive += 1
+        if self.state == "half-open" or (
+            self.state == "closed" and self._consecutive >= self.failures
+        ):
+            get_logger("resilience").warning(
+                "circuit breaker OPEN (%s) — serving fallback rows until "
+                "a probe succeeds",
+                "probe failed" if self.state == "half-open"
+                else f"{self._consecutive} consecutive failures",
+            )
+            self.state = "open"
+            self._since_probe = 0
+
+
+class DegradedFeature:
+    """Degraded-mode wrapper around a host feature-store lookup.
+
+    Wraps anything ids->rows indexable (``Feature``, ``ShardedFeature``,
+    a ``FaultPlan.wrap_feature`` product, …). While the breaker is
+    closed, lookups pass through and failures propagate — the retrying
+    Prefetcher upstream owns transients. Once ``failures`` consecutive
+    lookups fail (a cold-tier OUTAGE, not a blip), the breaker opens and
+    lookups serve ``fallback`` rows instead of raising, so the epoch
+    keeps streaming; every degraded call is counted on the graftscope
+    registry (``resilience.degraded_lookups``) and half-open probes close
+    the breaker when the store recovers.
+
+    Args:
+      feature: the wrapped store (must expose ``shape`` ``(n, dim)``; a
+        ``dtype`` / ``scale`` attribute refines the fallback row dtype).
+      failures: consecutive-failure threshold that opens the breaker.
+      probe_every: short-circuited calls between half-open probes.
+      fallback: ``"zeros"`` (constant rows) or ``"last-good"`` (each id's
+        most recently fetched rows from a bounded cache, zeros for ids
+        never seen) — degraded accuracy either way, but a finished epoch.
+      cache_rows: row budget of the last-good cache (insertion stops at
+        the budget; ``"zeros"`` keeps no cache).
+      metrics: optional external :class:`MetricsRegistry` to land the
+        degraded counter on (e.g. a trainer's); a private one otherwise.
+    """
+
+    _FALLBACKS = ("zeros", "last-good")
+
+    def __init__(self, feature, failures: int = 3, probe_every: int = 8,
+                 fallback: str = "zeros", cache_rows: int = 65536,
+                 metrics: MetricsRegistry | None = None):
+        if fallback not in self._FALLBACKS:
+            raise ValueError(
+                f"fallback must be one of {self._FALLBACKS}, "
+                f"got {fallback!r}"
+            )
+        self.feature = feature
+        self.breaker = CircuitBreaker(failures, probe_every)
+        self.fallback = fallback
+        self.cache_rows = int(cache_rows)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.metrics.counter(
+            DEGRADED_LOOKUPS, unit="lookups",
+            doc="feature lookups served by the circuit breaker's fallback "
+                "(zeros/last-good) instead of the real store",
+        )
+        self.degraded_total = 0
+        self._cache: dict[int, np.ndarray] = {}
+        self._row_dtype = None
+
+    def _row_spec(self):
+        """(dim, dtype) of a fallback row — from the last good rows when
+        seen, else from the wrapped store's declared shape/dtype (int8
+        storage dequantizes to float32, the same rows the model sees)."""
+        dim = int(self.feature.shape[1])
+        if self._row_dtype is not None:
+            return dim, self._row_dtype
+        if getattr(self.feature, "scale", None) is not None:
+            return dim, np.dtype(np.float32)
+        dtype = getattr(self.feature, "dtype", None)
+        return dim, np.dtype(dtype) if dtype is not None else np.dtype(
+            np.float32
+        )
+
+    def _remember(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        if self.fallback != "last-good":
+            return
+        for i, row in zip(ids.tolist(), rows):
+            if i < 0:
+                continue
+            if i in self._cache or len(self._cache) < self.cache_rows:
+                self._cache[i] = np.array(row)
+
+    def _serve_fallback(self, ids: np.ndarray):
+        dim, dtype = self._row_spec()
+        out = np.zeros((ids.shape[0], dim), dtype)
+        if self.fallback == "last-good" and self._cache:
+            for lane, i in enumerate(ids.tolist()):
+                row = self._cache.get(i)
+                if row is not None:
+                    out[lane] = row
+        self.degraded_total += 1
+        self.metrics.set(DEGRADED_LOOKUPS, np.int32(self.degraded_total))
+        return out
+
+    def __getitem__(self, ids):
+        ids_np = np.asarray(ids).reshape(-1)
+        if self.breaker.allow():
+            try:
+                rows = np.asarray(self.feature[ids])
+            except Exception:  # noqa: BLE001 — the breaker decides whether
+                self.breaker.record_failure()  # this failure surfaces
+                if self.breaker.state == "open":
+                    return self._serve_fallback(ids_np)
+                raise
+            self.breaker.record_success()
+            self._row_dtype = rows.dtype
+            self._remember(ids_np, rows)
+            return rows
+        return self._serve_fallback(ids_np)
+
+    def __getattr__(self, name):
+        return getattr(self.feature, name)

@@ -324,6 +324,18 @@ class DistGraphSageSampler(GraphSageSampler):
     def _init_topo(self, device_topo):
         return ShardedTopology(self.mesh, self.csr_topo, axis=self.axis)
 
+    def replan(self, mesh) -> "DistGraphSageSampler":
+        """Re-partition the topology onto a different mesh (elastic
+        resume) and drop the compiled-program cache (programs bake in the
+        old mesh). Sampling parameters, the PRNG stream, and the
+        bit-parity contract are untouched: per seed block and key, the
+        re-planned sampler draws exactly what the old one would — only
+        the owner routing changes shape."""
+        self.mesh = mesh
+        self.topo = self.topo.replan(mesh, axis=self.axis)
+        self._compiled_cache.clear()
+        return self
+
     @property
     def workers(self) -> int:
         """Seed-block workers: every device of the mesh."""

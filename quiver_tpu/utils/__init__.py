@@ -16,7 +16,7 @@ __all__ = [
 ]
 
 _LAZY = {
-    "Checkpointer": "checkpoint",  # keeps orbax an on-demand import
+    "Checkpointer": "checkpoint",
     "show_tensor_info": "debug",
     "tensor_info": "debug",
     "generate_pareto_graph": "graphgen",

@@ -39,13 +39,18 @@ SECTIONS = [
     ("quiver_tpu.parallel.pipeline", "Prefetcher"),
     ("quiver_tpu.resilience",
      "Fault tolerance — non-finite step guard, fault injection"),
+    ("quiver_tpu.resilience.elastic",
+     "Elastic mesh resilience — cross-mesh resume, circuit breaker"),
+    ("quiver_tpu.resilience.integrity",
+     "Checkpoint integrity — manifest schema, checksums, verification"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),
     ("quiver_tpu.ops.pallas.sample", "Pallas windowed sampler"),
     ("quiver_tpu.ops.pallas.gather", "Pallas row gather"),
     ("quiver_tpu.utils.reorder", "Degree-based feature reorder"),
-    ("quiver_tpu.utils.checkpoint", "Orbax checkpointing"),
+    ("quiver_tpu.utils.checkpoint",
+     "Atomic manifest checkpointing (integrity-verified)"),
     ("quiver_tpu.utils.trace", "Tracing/profiling scopes"),
     ("quiver_tpu.obs",
      "graftscope — metrics registry, step timeline, exporters"),

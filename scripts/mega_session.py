@@ -55,9 +55,11 @@ ORDER = [
     # on benchmarks whose numbers a broken invariant would poison
     ("lint", 120),
     # chaos drills right after lint: resilience regressions (guard,
-    # retry, checkpoint/resume bit-parity) fail the session early, before
-    # bench budget burns on a stack that can't survive a bad batch
-    ("chaos", 600),
+    # retry, checkpoint/resume bit-parity, elastic resize, corrupt-
+    # checkpoint fallback, cold-tier outage) fail the session early,
+    # before bench budget burns on a stack that can't survive a bad
+    # batch or a shrunk mesh
+    ("chaos", 900),
     ("primitives", 600),
     ("sampler-hbm", 1800),
     ("feature-replicate", 1200),

@@ -1,4 +1,8 @@
-"""Checkpoint/resume tests: round-trip, retention, latest-step, resume-training."""
+"""Checkpoint/resume tests: round-trip, retention, latest-step,
+resume-training, and the integrity layer (manifest checksums, atomic
+COMMIT, uncommitted-dir skipping, corrupt-checkpoint quarantine+fallback)."""
+
+import os
 
 import numpy as np
 import pytest
@@ -7,7 +11,17 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from quiver_tpu.resilience.integrity import FORMAT, CorruptCheckpoint
 from quiver_tpu.utils.checkpoint import Checkpointer
+
+
+def _flip_byte(path, where=0.5):
+    """Flip one payload byte (the corrupt-checkpoint drill's fault)."""
+    with open(path, "r+b") as fh:
+        fh.seek(int(os.path.getsize(path) * where))
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
 
 
 def _tree_equal(a, b):
@@ -95,9 +109,97 @@ def test_close_waits_for_inflight_async_save(tmp_path):
     ckpt.close()
     with Checkpointer(tmp_path / "ck") as reopened:
         assert reopened.latest_step() == 1
-        # template restore: a freshly-opened manager has no handler
-        # registry yet, so an untemplated restore cannot infer the tree
         _tree_equal(
             reopened.restore(template={"x": jnp.zeros(3)}),
             {"x": jnp.full(3, 7.0)},
         )
+
+
+# -- integrity: manifest, atomic commit, quarantine + fallback ----------------
+
+
+def test_manifest_roundtrip_and_verify(tmp_path):
+    """The manifest is mesh-agnostic and complete: per-leaf key path,
+    GLOBAL shape, dtype, content checksum, plus writer metadata — and a
+    committed save passes full verification."""
+    state = {
+        "params": {"w": jnp.arange(6.0).reshape(2, 3)},
+        "step": np.asarray(7, np.int32),
+        "opt": (jnp.zeros(2), jnp.ones(2)),  # tuple survives untemplated
+    }
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        assert ckpt.save(7, state, wait=True,
+                         metadata={"workers": 8, "local_batch": 16})
+        manifest = ckpt.verify(7)
+        assert manifest["format"] == FORMAT and manifest["step"] == 7
+        by_path = {rec["path"]: rec for rec in manifest["leaves"]}
+        w = by_path["['params']['w']"]
+        assert w["shape"] == [2, 3] and w["dtype"] == "float32"
+        assert by_path["['step']"]["shape"] == []  # 0-d stays 0-d
+        assert ckpt.metadata(7) == {"workers": 8, "local_batch": 16}
+        restored = ckpt.restore()
+        assert isinstance(restored["opt"], tuple)
+        _tree_equal(restored, state)
+
+
+def test_uncommitted_partial_directory_is_invisible(tmp_path):
+    """A crash mid-save leaves a directory without the COMMIT marker —
+    latest_step/all_steps/restore must never see it."""
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(1, {"x": jnp.full(2, 1.0)}, wait=True)
+        partial = tmp_path / "ck" / "step-9"
+        partial.mkdir()
+        (partial / "arrays.bin").write_bytes(b"\x00" * 16)  # no COMMIT
+        assert ckpt.latest_step() == 1
+        assert ckpt.all_steps() == [1]
+        _tree_equal(ckpt.restore(), {"x": jnp.full(2, 1.0)})
+
+
+def test_corrupt_newest_quarantines_and_falls_back(tmp_path, caplog):
+    """Acceptance: flipped manifest-covered bytes in the newest checkpoint
+    -> one-shot log, quarantine rename, automatic fallback to the newest
+    VALID checkpoint — no manual intervention, no garbage restore."""
+    import logging
+
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(1, {"x": jnp.full(2, 1.0)}, wait=True)
+        ckpt.save(2, {"x": jnp.full(2, 2.0)}, wait=True)
+        _flip_byte(tmp_path / "ck" / "step-2" / "arrays.bin")
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            _tree_equal(
+                ckpt.restore(template={"x": jnp.zeros(2)}),
+                {"x": jnp.full(2, 1.0)},
+            )
+        assert any("quarantined" in r.message for r in caplog.records)
+        assert ckpt.all_steps() == [1]  # the corrupt dir left the scan
+        assert any(
+            name.startswith("quarantine-")
+            for name in os.listdir(tmp_path / "ck")
+        )
+
+
+def test_explicit_corrupt_step_raises(tmp_path):
+    """An explicitly-pinned step that fails verification raises instead
+    of silently serving a different step."""
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(1, {"x": jnp.zeros(2)}, wait=True)
+        ckpt.save(2, {"x": jnp.ones(2)}, wait=True)
+        _flip_byte(tmp_path / "ck" / "step-2" / "arrays.bin")
+        with pytest.raises(CorruptCheckpoint, match="checksum"):
+            ckpt.restore(step=2)
+
+
+def test_integrity_enforces_retention_floor(tmp_path):
+    """checkpoint_keep >= 2 while integrity is on: a window of one leaves
+    nothing to fall back to."""
+    with pytest.raises(ValueError, match="max_to_keep"):
+        Checkpointer(tmp_path / "ck", max_to_keep=1)
+    # opting out of integrity opts out of the floor
+    Checkpointer(tmp_path / "ck2", max_to_keep=1, integrity=False).close()
+
+
+def test_template_mismatch_raises(tmp_path):
+    with Checkpointer(tmp_path / "ck") as ckpt:
+        ckpt.save(1, {"x": jnp.zeros(2)}, wait=True)
+        with pytest.raises(ValueError, match="template"):
+            ckpt.restore(template={"x": jnp.zeros(3)})

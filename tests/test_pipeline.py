@@ -175,6 +175,33 @@ def test_retry_recovers_transient_faults_bit_identically(setup):
         )
 
 
+def test_retry_skip_counters_land_on_registry(setup):
+    """Satellite: the lifetime retry/skip totals ride a graftscope
+    MetricsRegistry (not just the StepTimeline), so metrics_report shows
+    pipeline health alongside the in-program resilience counters."""
+    from quiver_tpu.obs.registry import (
+        PREFETCH_RETRIES,
+        PREFETCH_SKIPS,
+        MetricsRegistry,
+    )
+    from quiver_tpu.resilience import FaultPlan
+
+    topo, _ = setup
+    seeds = _seed_stream(4, 16, topo.node_count)
+    # batch 1: two transients (recovered); batch 3: poisoned past retries
+    faulty = FaultPlan(sampler_faults={1: 2, 3: 5}).wrap_sampler(
+        _fresh_sampler(topo)
+    )
+    reg = MetricsRegistry()
+    pf = Prefetcher(faulty, None, depth=1, retries=2, backoff=0.0,
+                    skip_policy="skip", metrics=reg)
+    batches = list(pf.run(seeds))
+    assert len(batches) == 3  # batch 3 dropped
+    assert pf.retries_total == 4 and pf.skips_total == 1
+    assert int(np.asarray(reg.value(PREFETCH_RETRIES))) == 4
+    assert int(np.asarray(reg.value(PREFETCH_SKIPS))) == 1
+
+
 def test_retry_exhaustion_raises_in_order(setup):
     from quiver_tpu.resilience import FaultPlan, TransientFault
 

@@ -1,11 +1,14 @@
 """Resilience-layer tests: non-finite step guard, deterministic fault
-injection, checkpoint/auto-resume.
+injection, checkpoint/auto-resume, and the elastic layer (circuit
+breaker, replan seams, cross-mesh resume).
 
 Fast lane: FaultPlan semantics, guard skip/counter behavior over eager
-steps, constructor validation, empty-checkpoint resume passthrough.
+steps, constructor validation, empty-checkpoint resume passthrough,
+circuit-breaker state machine, degraded-feature fallback, replan shrink
+math at F=8->4->2, and the elastic-resume validation errors.
 Slow lane: the epoch-level differentials — guard on/off bit-parity with
-zero faults, and the preemption drill (kill at step k via FaultPlan,
-resume, compare the remaining loss trajectory bitwise).
+zero faults, the preemption drill, and the cross-mesh elastic resume
+(kill at F=8, resume(mesh=F4), remaining trajectory bitwise identical).
 """
 
 import numpy as np
@@ -16,12 +19,17 @@ import jax.numpy as jnp
 import optax
 
 from quiver_tpu import CSRTopo, FaultPlan, GraphSageSampler, Preemption
+from quiver_tpu.core.sharded_topology import ShardedTopology
 from quiver_tpu.feature.shard import ShardedFeature
 from quiver_tpu.models.sage import GraphSAGE
 from quiver_tpu.obs.registry import GUARD_NONFINITE, GUARD_SKIPPED
 from quiver_tpu.parallel.mesh import make_mesh
 from quiver_tpu.parallel.trainer import DistributedTrainer
-from quiver_tpu.resilience import TransientFault
+from quiver_tpu.resilience import (
+    CircuitBreaker,
+    DegradedFeature,
+    TransientFault,
+)
 from quiver_tpu.resilience.guard import nonfinite_count
 
 
@@ -205,6 +213,246 @@ def test_resume_empty_directory_passes_through(tmp_path):
     trainer.checkpointer.close()
 
 
+# -- circuit breaker / degraded feature serving -------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    """closed -> open after N consecutive failures -> count-based
+    half-open probes; a failed probe reopens, a success closes."""
+    br = CircuitBreaker(failures=2, probe_every=3)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # under threshold: caller still sees it
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow() and not br.allow()  # short-circuited
+    assert br.allow() and br.state == "half-open"  # 3rd call probes
+    br.record_failure()
+    assert br.state == "open"  # failed probe reopens
+    assert not br.allow() and not br.allow()
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    # a success resets the consecutive count in closed state too
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"
+    with pytest.raises(ValueError, match="failures"):
+        CircuitBreaker(failures=0)
+
+
+class _FlakyStore:
+    """ids -> rows store whose lookups fail while ``outage`` is set."""
+
+    def __init__(self, feat):
+        self.feat = feat
+        self.shape = feat.shape
+        self.dtype = feat.dtype
+        self.outage = False
+
+    def __getitem__(self, ids):
+        if self.outage:
+            raise TransientFault("cold tier down")
+        return self.feat[np.clip(np.asarray(ids), 0, None)]
+
+
+def test_degraded_feature_fallback_and_counter():
+    feat = np.arange(40, dtype=np.float32).reshape(10, 4)
+    store = _FlakyStore(feat)
+    wrapped = DegradedFeature(store, failures=2, probe_every=2,
+                              fallback="zeros")
+    ids = np.arange(3)
+    np.testing.assert_array_equal(wrapped[ids], feat[:3])  # healthy
+    store.outage = True
+    with pytest.raises(TransientFault):  # closed: failure 1 propagates
+        wrapped[ids]
+    rows = wrapped[ids]  # failure 2 opens the breaker -> fallback, no raise
+    np.testing.assert_array_equal(rows, np.zeros((3, 4), np.float32))
+    assert wrapped.breaker.state == "open"
+    rows = wrapped[ids]  # short-circuited
+    np.testing.assert_array_equal(rows, 0)
+    store.outage = False
+    np.testing.assert_array_equal(wrapped[ids], feat[:3])  # probe closes
+    assert wrapped.breaker.state == "closed"
+    assert wrapped.degraded_total == 2
+    from quiver_tpu.obs.registry import DEGRADED_LOOKUPS
+
+    assert int(np.asarray(wrapped.metrics.value(DEGRADED_LOOKUPS))) == 2
+
+
+def test_degraded_feature_last_good_rows():
+    feat = np.arange(40, dtype=np.float32).reshape(10, 4)
+    store = _FlakyStore(feat)
+    wrapped = DegradedFeature(store, failures=1, probe_every=100,
+                              fallback="last-good")
+    wrapped[np.array([2, 5])]  # caches rows 2 and 5
+    store.outage = True
+    rows = wrapped[np.array([5, 7, 2, -1])]  # opens on first failure
+    np.testing.assert_array_equal(rows[0], feat[5])  # last-good
+    np.testing.assert_array_equal(rows[1], 0)  # never seen -> zeros
+    np.testing.assert_array_equal(rows[2], feat[2])
+    np.testing.assert_array_equal(rows[3], 0)  # invalid lane -> zeros
+    with pytest.raises(ValueError, match="fallback"):
+        DegradedFeature(store, fallback="nonsense")
+
+
+# -- elastic replan seams (shrink math; host-side, no compile) ----------------
+
+
+def _line_topo(n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    return CSRTopo(
+        edge_index=rng.integers(0, n, size=(2, 800)).astype(np.int64)
+    )
+
+
+def test_sharded_topology_replan_shrink_math():
+    """F=8 -> 4 -> 2: rows_per_shard doubles, the partition stays a full
+    cover of the same graph, and per-chip bytes grow as shards widen."""
+    topo = _line_topo()
+    t8 = ShardedTopology(make_mesh(data=1, feature=8), topo)
+    t4 = t8.replan(make_mesh(n_devices=4, data=1, feature=4))
+    t2 = t4.replan(make_mesh(n_devices=2, data=1, feature=2))
+    for t, f in ((t8, 8), (t4, 4), (t2, 2)):
+        assert t.num_shards == f
+        assert t.node_count == topo.node_count
+        assert t.edge_count == int(topo.indptr[-1])
+        assert t.rows_per_shard == -(-topo.node_count // f)
+        assert sum(t.plan["shard_edges"]) == t.edge_count  # full cover
+        assert np.asarray(t.indptr).shape == (f, t.rows_per_shard + 1)
+    assert t2.rows_per_shard == 2 * t4.rows_per_shard == 4 * t8.rows_per_shard
+    assert (t8.plan["per_chip_bytes"] < t4.plan["per_chip_bytes"]
+            < t2.plan["per_chip_bytes"])
+    assert t8.plan["shrink_factor"] > t4.plan["shrink_factor"] > 1.0
+
+
+def test_sharded_feature_replan_preserves_rows():
+    """F=8 -> 4 -> 2: the same per-device budget buys half the sharded
+    rows each halving (spill to cold), but the translated row space and
+    every row's bytes are reused verbatim — gathers stay bit-identical."""
+    topo = _line_topo()
+    n, d = 96, 8
+    feat = np.random.default_rng(1).normal(size=(n, d)).astype(np.float32)
+    row_bytes = d * 4
+    store = ShardedFeature(
+        make_mesh(data=1, feature=8), device_cache_size=6 * row_bytes,
+        replicate_budget=8 * row_bytes, csr_topo=topo,
+    ).from_cpu_tensor(feat)
+
+    def reassemble(s):
+        parts = []
+        if s.rep is not None:
+            parts.append(np.asarray(s.rep))
+        if s.hot is not None:
+            parts.append(np.asarray(s.hot.table)[: s.hot_rows])
+        if s.cold is not None:
+            parts.append(np.asarray(s.cold))
+        return np.concatenate(parts)
+
+    order = np.asarray(store.feature_order)
+    baseline = reassemble(store)
+    # the translated space IS the original rows, permuted by the order
+    np.testing.assert_array_equal(baseline[order], feat)
+    assert store.rep_rows == 8 and store.hot_rows == 6 * 8
+    for f in (4, 2):
+        store.replan(make_mesh(n_devices=f, data=1, feature=f))
+        assert store.rep_rows == 8  # replication cost is per device
+        assert store.hot_rows == 6 * f  # budget x fewer shards
+        assert store.mesh.shape["feature"] == f
+        np.testing.assert_array_equal(
+            np.asarray(store.feature_order), order
+        )
+        np.testing.assert_array_equal(reassemble(store), baseline)
+
+
+# -- elastic resume validation (fast; no step compiles) -----------------------
+
+
+def _build_elastic(mesh, workers, checkpoint_dir=None, topo=None,
+                   feat=None, plan=None):
+    topo = _line_topo() if topo is None else topo
+    n = topo.node_count
+    if feat is None:
+        feat = np.random.default_rng(1).normal(size=(n, 8)).astype(
+            np.float32
+        )
+    store = ShardedFeature(
+        mesh, device_cache_size=6 * 8 * 4, replicate_budget=8 * 8 * 4,
+        csr_topo=topo,
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [3, 2], seed=0, seed_capacity=8,
+                               topo_sharding="mesh", mesh=mesh)
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=2)
+    kw = {}
+    if checkpoint_dir is not None:
+        kw = dict(checkpoint_dir=checkpoint_dir, checkpoint_every=3)
+    return DistributedTrainer(
+        mesh, sampler, store, model, optax.sgd(1e-2), local_batch=8,
+        seed_sharding="all", logical_workers=workers, fault_plan=plan, **kw
+    )
+
+
+def test_logical_workers_validation():
+    mesh = make_mesh(data=2, feature=4)
+    with pytest.raises(ValueError, match="multiple"):
+        _build_elastic(mesh, workers=12)  # not a multiple of 8
+    with pytest.raises(ValueError, match="seed_sharding"):
+        topo = _line_topo()
+        store = ShardedFeature(mesh, device_cache_size=96 * 8 * 4)
+        store = store.from_cpu_tensor(
+            np.zeros((96, 8), np.float32)
+        )
+        DistributedTrainer(
+            mesh, GraphSageSampler(topo, [3], seed=0, seed_capacity=8),
+            store, GraphSAGE(hidden=8, num_classes=4, num_layers=1),
+            optax.sgd(1e-2), local_batch=8, seed_sharding="data",
+            logical_workers=8,
+        )
+
+
+def test_resume_mesh_mismatch_requires_elastic_opt_in(tmp_path):
+    """Satellite: a checkpoint written on another mesh shape must not be
+    device_put blindly — resume() raises unless resume(mesh=) opts in,
+    and the metadata validation catches worker/step mismatches."""
+    topo = _line_topo()
+    mesh8 = make_mesh(data=1, feature=8)
+    writer = _build_elastic(mesh8, workers=8, checkpoint_dir=tmp_path / "ck",
+                            topo=topo)
+    params, opt = writer.init(jax.random.PRNGKey(0))
+    writer._save_checkpoint(params, opt, jax.random.PRNGKey(7), 0, 3,
+                            steps_per_epoch=9)
+    writer.checkpointer.close()
+
+    mesh4 = make_mesh(n_devices=4, data=1, feature=4)
+    # the real process-death flow: a FRESH trainer on the smaller mesh
+    reader = _build_elastic(mesh4, workers=8,
+                            checkpoint_dir=tmp_path / "ck", topo=topo)
+    with pytest.raises(ValueError, match="resume\\(mesh="):
+        reader.resume(params, opt)  # shape changed; no opt-in
+    p, o, key, step, epoch = reader.resume(params, opt, mesh=reader.mesh)
+    assert step == 3 and epoch == 0 and key is not None
+    assert reader.blocks_per_device == 2
+
+    # a wrong logical worker count is caught by the manifest metadata
+    wrong = _build_elastic(mesh4, workers=4,
+                           checkpoint_dir=tmp_path / "ck", topo=topo)
+    with pytest.raises(ValueError, match="logical workers"):
+        wrong.resume(params, opt, mesh=wrong.mesh)
+    wrong.checkpointer.close()
+
+    # a step outside the saved epoch's geometry is rejected
+    writer2 = _build_elastic(mesh8, workers=8,
+                             checkpoint_dir=tmp_path / "ck2", topo=topo)
+    writer2._save_checkpoint(params, opt, jax.random.PRNGKey(7), 0, 99,
+                             steps_per_epoch=9)
+    writer2.checkpointer.wait_until_finished()
+    with pytest.raises(ValueError, match="outside"):
+        writer2.resume(params, opt)
+    writer2.checkpointer.close()
+    reader.checkpointer.close()
+
+
 # -- epoch-level differentials (slow lane) ------------------------------------
 
 
@@ -288,5 +536,69 @@ def test_preemption_drill_resume_bit_parity(tmp_path):
         pr2, or2, seed_mat, labels, key2, start_step=step2
     )
     assert np.asarray(empty).shape == (0,)
+    trainer_a.checkpointer.close()
+    trainer_b.checkpointer.close()
+
+
+@pytest.mark.slow
+def test_elastic_resume_cross_mesh_bit_parity(tmp_path):
+    """Acceptance e2e (the tentpole): checkpoint at step k on an F=8 mesh,
+    kill, resume(mesh=F4) — the sharded topology and three-tier feature
+    store re-plan onto half the devices, each device picks up two logical
+    seed blocks, and the remaining loss trajectory AND final params are
+    bit-identical to the uninterrupted F=8 run. A second resume onto F=2
+    (quartered mesh) reproduces the same tail."""
+    topo = _line_topo()
+    labels = jnp.asarray(
+        np.random.default_rng(0).integers(0, 4, topo.node_count).astype(
+            np.int32
+        )
+    )
+    mesh8 = make_mesh(data=1, feature=8)
+    trainer_a = _build_elastic(mesh8, workers=8,
+                               checkpoint_dir=tmp_path / "a", topo=topo)
+    seed_mat = trainer_a.pack_epoch(np.tile(np.arange(96), 6), seed=0)
+    assert seed_mat.shape[0] == 9
+    key = jax.random.PRNGKey(7)
+    pa, oa = trainer_a.init(jax.random.PRNGKey(0))
+    pa, oa, losses_a = trainer_a.epoch_scan(pa, oa, seed_mat, labels, key)
+    losses_a = np.asarray(losses_a)
+
+    trainer_b = _build_elastic(mesh8, workers=8,
+                               checkpoint_dir=tmp_path / "b", topo=topo,
+                               plan=FaultPlan(preempt_at_step=4))
+    p0, o0 = trainer_b.init(jax.random.PRNGKey(0))
+    with pytest.raises(Preemption, match="step 4"):
+        trainer_b.epoch_scan(p0, o0, seed_mat, labels, key)
+    mesh4 = make_mesh(n_devices=4, data=1, feature=4)
+    pr, orr, key_r, step, epoch = trainer_b.resume(p0, o0, mesh=mesh4)
+    assert step == 3 and trainer_b.blocks_per_device == 2
+    assert trainer_b.feature.mesh is mesh4
+    assert trainer_b.sampler.topo.num_shards == 4
+    pr, orr, losses_r = trainer_b.epoch_scan(
+        pr, orr, seed_mat, labels, key_r, epoch=epoch, start_step=step
+    )
+    losses_r = np.asarray(losses_r)
+    np.testing.assert_array_equal(
+        losses_r.view(np.uint32), losses_a[step:].view(np.uint32)
+    )
+    assert _tree_bitwise_equal(pa, pr)
+
+    # shrink AGAIN: F=4 -> F=2, pinning the ORIGINAL pre-kill checkpoint
+    # (the resumed F=4 epoch checkpointed its own later chunks on top)
+    mesh2 = make_mesh(n_devices=2, data=1, feature=2)
+    first_seq = trainer_b.checkpointer.all_steps()[0]
+    pr2, or2, key_r2, step2, epoch2 = trainer_b.resume(
+        p0, o0, mesh=mesh2, checkpoint_step=first_seq
+    )
+    assert step2 == 3 and trainer_b.blocks_per_device == 4
+    pr2, or2, losses_r2 = trainer_b.epoch_scan(
+        pr2, or2, seed_mat, labels, key_r2, epoch=epoch2, start_step=step2
+    )
+    np.testing.assert_array_equal(
+        np.asarray(losses_r2).view(np.uint32),
+        losses_a[step2:].view(np.uint32),
+    )
+    assert _tree_bitwise_equal(pa, pr2)
     trainer_a.checkpointer.close()
     trainer_b.checkpointer.close()
