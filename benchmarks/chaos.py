@@ -22,11 +22,19 @@ layer guarantees (quiver_tpu/resilience/):
 * **cold-outage**: a cold-tier outage (consecutive feature-lookup
   failures) trips the circuit breaker into degraded serving — the epoch
   completes with ``resilience.degraded_lookups > 0`` instead of crashing,
-  and a half-open probe closes the breaker once the outage ends.
+  and a half-open probe closes the breaker once the outage ends;
+* **mutate**: the streaming-mutation drill (quiver_tpu/streaming) — a
+  malformed delta batch is quarantined whole at admission (counted,
+  never staged), a mid-commit crash (injected at every pre-publish
+  stage) leaves the old version readable with SAMPLING BIT-IDENTICAL to
+  the pre-commit oracle and the failed commit quarantined not
+  half-applied, and a successful commit bumps the version exactly once —
+  stale samplers raise until refreshed, then serve the mutated graph.
 
 Any drill failure raises (the session marks the job failed); success
 prints one ``CHAOS <drill> OK`` line per drill. ``--drills`` selects a
-subset (the CI smoke runs ``--drills corrupt`` on a 2-device CPU mesh).
+subset (the CI smoke runs ``--drills corrupt mutate`` on a 2-device CPU
+mesh).
 
     python -m benchmarks.chaos --smoke
 """
@@ -38,7 +46,8 @@ import numpy as np
 
 from benchmarks import common
 
-DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage")
+DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage",
+          "mutate")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -428,6 +437,110 @@ def drill_cold_outage(topo, feat, labels, local_batch, seed):
     )
 
 
+def drill_mutate(topo_seed_graph, feat, local_batch, seed):
+    """Malformed-delta quarantine; mid-commit crash at every pre-publish
+    stage leaves the old version readable and sampling bit-identical;
+    a published commit invalidates stale samplers exactly once."""
+    import jax
+
+    from quiver_tpu import (
+        CommitAborted,
+        CSRTopo,
+        DeltaBatch,
+        GraphSageSampler,
+        StreamingGraph,
+        VersionMismatchError,
+    )
+    from quiver_tpu.feature.shard import ShardedFeature
+    from quiver_tpu.obs.registry import DELTAS_QUARANTINED
+    from quiver_tpu.parallel.mesh import FEATURE_AXIS, make_mesh
+
+    F = jax.device_count()
+    mesh = make_mesh(n_devices=F, data=1, feature=F)
+    # a fresh topology: the drill mutates it, the shared one must survive
+    rng = np.random.default_rng(seed)
+    n = topo_seed_graph.node_count
+    topo = CSRTopo(indptr=topo_seed_graph.indptr,
+                   indices=topo_seed_graph.indices)
+    d = feat.shape[1]
+    store = ShardedFeature(
+        mesh, device_cache_size=max(n // (2 * F), 1) * d * feat.dtype.itemsize,
+        replicate_budget=8 * d * feat.dtype.itemsize, csr_topo=topo,
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [5, 5], seed=3,
+                               seed_capacity=local_batch,
+                               topo_sharding="mesh", mesh=mesh)
+    sg = StreamingGraph(topo, feature=store)
+    seeds = rng.integers(0, n, local_batch * F)
+    key = jax.random.PRNGKey(11)
+    oracle = sampler.sample(seeds, key=key)
+
+    # 1. malformed batches: quarantined whole, never staged
+    rejects = (
+        DeltaBatch(edge_inserts=np.array([[0], [n + 7]]), tag="oob"),
+        DeltaBatch(update_ids=np.array([1]),
+                   update_rows=np.full((1, d), np.nan, np.float32),
+                   tag="nan-row"),
+        DeltaBatch(edge_inserts=np.array([[2, 2], [3, 3]]), tag="dup"),
+    )
+    for bad in rejects:
+        assert not sg.ingest(bad), f"malformed batch {bad.tag} was staged"
+    q = int(np.asarray(sg.metrics.value(DELTAS_QUARANTINED)))
+    assert q == len(rejects), f"quarantine counter {q} != {len(rejects)}"
+    assert not sg.staged
+
+    # 2. mid-commit crash at every pre-publish stage: old version stays
+    # readable and sampling is bit-identical to the pre-commit oracle
+    live_src = int(np.repeat(
+        np.arange(n), topo.degree)[0])  # a row with at least one edge
+    live_dst = int(np.asarray(topo.indices)[
+        np.asarray(topo.indptr, dtype=np.int64)[live_src]])
+    good = DeltaBatch(
+        edge_inserts=rng.integers(0, n, size=(2, 8)),
+        edge_deletes=np.array([[live_src], [live_dst]]),
+        update_ids=np.array([0, n // 2]),
+        update_rows=rng.normal(size=(2, d)).astype(np.float32),
+    )
+    for stage in ("merge", "verify", "features"):
+        assert sg.ingest(good), f"good batch rejected before {stage}"
+        try:
+            sg.commit(inject_failure=stage)
+            raise AssertionError(f"injected {stage} failure did not abort")
+        except CommitAborted:
+            pass
+        assert topo.version == 0 and store.version == 0, \
+            f"crash at {stage} leaked a version bump"
+        assert not sg.staged, f"crash at {stage} left batches staged"
+        replay = sampler.sample(seeds, key=key)
+        assert np.array_equal(np.asarray(oracle.n_id),
+                              np.asarray(replay.n_id)), \
+            f"sampling diverged after aborted commit at {stage}"
+
+    # 3. a real commit publishes once; stale sampler raises, refreshed
+    # sampler serves the mutated graph
+    assert sg.ingest(good)
+    res = sg.commit()
+    assert res.version == 1 and topo.version == 1 and store.version == 1
+    try:
+        sampler.sample(seeds, key=key)
+        raise AssertionError("stale sampler did not raise after commit")
+    except VersionMismatchError:
+        pass
+    sampler.refresh_topology()
+    out = sampler.sample(seeds, key=key)
+    assert out.n_id.shape == oracle.n_id.shape
+    updated = np.asarray(store.gather(good.update_ids))
+    assert np.array_equal(updated, good.update_rows), \
+        "committed row updates not served"
+    common.log(
+        f"CHAOS mutate OK ({len(rejects)} malformed batches quarantined; "
+        f"3 mid-commit crashes rolled back bit-identically; commit v1 "
+        f"published +{res.edges_inserted}/-{res.edges_deleted} edges, "
+        f"{res.rows_updated} row updates, stale sampler raised then "
+        f"refreshed)"
+    )
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=2000)
@@ -469,6 +582,8 @@ def main():
             drill_cold_outage(
                 topo, feat, labels, args.local_batch, args.seed
             )
+        if "mutate" in selected:
+            drill_mutate(topo, feat, args.local_batch, args.seed)
         common.log(f"CHAOS all drills passed ({', '.join(selected)})")
         return 0
 

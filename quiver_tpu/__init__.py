@@ -44,6 +44,13 @@ from .resilience import (
 )
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
+from .streaming import (
+    CommitAborted,
+    DeltaBatch,
+    DeltaRejected,
+    StreamingGraph,
+    VersionMismatchError,
+)
 from .utils.debug import show_tensor_info, tensor_info
 from .utils.reorder import reorder_by_degree
 from .utils.trace import Timer, enable_trace, get_logger, trace_scope
@@ -105,6 +112,11 @@ __all__ = [
     "CircuitBreaker",
     "CorruptCheckpoint",
     "DegradedFeature",
+    "DeltaBatch",
+    "DeltaRejected",
+    "StreamingGraph",
+    "CommitAborted",
+    "VersionMismatchError",
 ]
 
 __version__ = "0.1.0"

@@ -101,6 +101,10 @@ class ShardedTopology:
         self.max_degree = int(csr_topo.max_degree)
         self.num_shards = F
         self.rows_per_shard = rps
+        # the committed mutation version this partition was built from
+        # (streaming commits bump the host CSR's version; a consumer
+        # comparing the two detects a stale device partition)
+        self.version = int(getattr(csr_topo, "version", 0))
 
         # the partition plan — per-chip byte accounting the acceptance
         # criteria assert on (padded_edges is the widest shard, so skewed

@@ -14,6 +14,8 @@ reference's UVA zero-copy registration, quiver_sample.cu:400-408).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -22,7 +24,28 @@ import jax.numpy as jnp
 from .config import SampleMode
 from .memory import to_pinned_host
 
-__all__ = ["CSRTopo", "DeviceTopology"]
+__all__ = ["CSRTopo", "DeviceTopology", "VersionMismatchError"]
+
+
+class VersionMismatchError(RuntimeError):
+    """A consumer holds a placement of graph state (device CSR partition,
+    feature tiers, a trainer's captured operands) whose ``version`` no
+    longer matches the committed host state — a streaming mutation
+    (``quiver_tpu.streaming``) published a new version since the placement
+    was built. Raised instead of serving a silently stale read; call the
+    consumer's ``refresh``/``refresh_topology`` seam to re-place."""
+
+
+def _boundary_checks_enabled() -> bool:
+    """O(E)/O(n) construction-boundary scans (index ranges, indptr
+    monotonicity) run by DEFAULT — a corrupt CSR reaching XLA's clamping
+    gathers turns into silently wrong samples, which is far worse than the
+    scan. ``QUIVER_CHECK=0`` opts out for huge graphs on a hot rebuild
+    path. (Asymmetric with models/layers: the *debug* trace assertions
+    there default OFF; these *boundary* validations default ON. Host-side
+    eager code — never trace-resident, so the env read per construction is
+    trace-safe.)"""
+    return os.environ.get("QUIVER_CHECK", "1") not in ("0", "false", "False")
 
 
 def _as_numpy(x) -> np.ndarray:
@@ -115,7 +138,11 @@ class CSRTopo:
             # would otherwise turn inconsistencies into silently wrong samples
             if indptr.ndim != 1 or indptr.shape[0] < 1 or indptr[0] != 0:
                 raise ValueError("indptr must be 1-D and start at 0")
-            if np.any(np.diff(indptr) < 0):
+            if indices.ndim != 1:
+                raise ValueError(
+                    f"indices must be 1-D, got shape {indices.shape}"
+                )
+            if _boundary_checks_enabled() and np.any(np.diff(indptr) < 0):
                 raise ValueError("indptr must be non-decreasing")
             if int(indptr[-1]) != indices.shape[0]:
                 raise ValueError(
@@ -125,11 +152,18 @@ class CSRTopo:
             raise ValueError("need edge_index or indptr+indices")
 
         node_count = int(indptr.shape[0] - 1)
-        if indices.size and int(indices.max()) >= node_count:
-            raise ValueError(
-                f"indices reference node {int(indices.max())} but indptr only "
-                f"defines {node_count} nodes"
-            )
+        if indices.size and _boundary_checks_enabled():
+            lo, hi = int(indices.min()), int(indices.max())
+            if lo < 0:
+                raise ValueError(
+                    f"indices contain negative node id {lo}; CSR neighbor "
+                    f"slots must reference nodes in [0, {node_count})"
+                )
+            if hi >= node_count:
+                raise ValueError(
+                    f"indices reference node {hi} but indptr only "
+                    f"defines {node_count} nodes"
+                )
         edge_count = int(indptr[-1])
         self._indptr = indptr.astype(_index_dtype(edge_count), copy=False)
         self._indices = indices.astype(_index_dtype(max(node_count - 1, 0)), copy=False)
@@ -137,6 +171,11 @@ class CSRTopo:
         self._feature_order = None  # set by Feature's degree reorder
         self._edge_weight = None
         self._cum_weights = None
+        # streaming-mutation version: bumped ONCE per committed transaction
+        # (quiver_tpu.streaming); device placements capture the version they
+        # were built from and raise VersionMismatchError instead of serving
+        # a stale partition after a commit
+        self._version = 0
         if edge_weight is not None:
             self.set_edge_weight(edge_weight, coo_order=edge_index is not None)
 
@@ -208,6 +247,39 @@ class CSRTopo:
         return self._cum_weights
 
     @property
+    def version(self) -> int:
+        """Committed mutation version (0 for a freshly built topology;
+        +1 per published ``quiver_tpu.streaming`` commit). Consumers
+        compare their placed version against this to detect staleness."""
+        return self._version
+
+    def _publish_mutation(self, indptr: np.ndarray,
+                          indices: np.ndarray) -> None:
+        """Streaming-commit publish seam (``quiver_tpu.streaming`` only):
+        swap in the merged, already-VERIFIED CSR arrays and bump the
+        version — the single publication point of an atomic commit. Every
+        array is built and checked aside before this runs; the method body
+        is a handful of reference assignments, so there is no window in
+        which a reader can observe a half-applied merge. ``eid`` is
+        dropped (COO provenance does not survive mutation);
+        ``feature_order`` is kept (the node id space is invariant —
+        streaming deltas never add or remove nodes); weighted topologies
+        are rejected upstream by the streaming layer."""
+        if self._edge_weight is not None:
+            raise ValueError(
+                "cannot publish a mutation onto a weighted topology "
+                "(the streaming layer rejects these at construction)"
+            )
+        edge_count = int(indptr[-1])
+        node_count = int(indptr.shape[0] - 1)
+        self._indptr = indptr.astype(_index_dtype(edge_count), copy=False)
+        self._indices = indices.astype(
+            _index_dtype(max(node_count - 1, 0)), copy=False
+        )
+        self._eid = None
+        self._version += 1
+
+    @property
     def degree(self) -> np.ndarray:
         return np.diff(self._indptr)
 
@@ -232,23 +304,67 @@ class CSRTopo:
         """Persist the topology (CSR + eid + weights + feature_order) as
         one ``.npz``. The reference's users ``torch.save`` their CSR
         preprocessing artifacts (benchmarks/ogbn-papers100M/preprocess.py);
-        this is the same round-trip without a torch dependency."""
+        this is the same round-trip without a torch dependency.
+
+        Atomic publish (the checkpoint-store idiom, utils/checkpoint.py):
+        the bytes land in a same-directory temp file, are fsynced, and one
+        ``os.replace`` renames them into place — a crash mid-save can
+        leave a stale temp file but never a torn topology at ``path``."""
         arrays = {"indptr": self._indptr, "indices": self._indices}
         for name in ("eid", "edge_weight", "feature_order"):
             v = getattr(self, f"_{name}")
             if v is not None:
                 arrays[name] = v
-        with open(path, "wb") as fh:  # exact filename, no np .npz suffixing
-            np.savez(fh, **arrays)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:  # exact filename, no np suffixing
+                np.savez(fh, **arrays)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     @classmethod
     def load(cls, path: str) -> "CSRTopo":
         """Rebuild a :meth:`save`'d topology. Weights re-derive their
         per-row prefix sums; they are stored CSR-ordered, so coo_order is
-        False on the way back in."""
-        with np.load(path) as z:
-            topo = cls(indptr=z["indptr"], indices=z["indices"],
-                       eid=z["eid"] if "eid" in z.files else None)
+        False on the way back in.
+
+        A truncated, corrupt, or foreign ``.npz`` raises a clear
+        ``ValueError`` naming the file — np.load's raw zipfile errors (or
+        a KeyError three stack frames later) left the operator guessing
+        which artifact was bad."""
+        import zipfile
+
+        try:
+            z = np.load(path)
+        except (OSError, ValueError, EOFError, zipfile.BadZipFile) as e:
+            raise ValueError(
+                f"{path}: not a readable topology file — truncated, "
+                f"corrupt, or not an .npz ({type(e).__name__}: {e})"
+            ) from None
+        with z:
+            missing = [k for k in ("indptr", "indices") if k not in z.files]
+            if missing:
+                raise ValueError(
+                    f"{path}: topology file lacks required array(s) "
+                    f"{missing} (has {sorted(z.files)}) — truncated save "
+                    f"or not a CSRTopo artifact"
+                )
+            try:
+                topo = cls(indptr=z["indptr"], indices=z["indices"],
+                           eid=z["eid"] if "eid" in z.files else None)
+            except (OSError, ValueError, EOFError,
+                    zipfile.BadZipFile) as e:
+                raise ValueError(
+                    f"{path}: topology arrays failed to load/validate "
+                    f"({e})"
+                ) from None
             if "edge_weight" in z.files:
                 topo.set_edge_weight(z["edge_weight"], coo_order=False)
             if "feature_order" in z.files:

@@ -474,6 +474,12 @@ class ShardedFeature(KernelChoice):
         # rebuilds both tiers from it without touching the cold tier
         self._region_host = None
         self._rep_ceiling_rows = 0  # auto_split never grows L0 past this
+        # streaming-mutation version: bumped ONCE per published
+        # apply_row_updates transaction. Consumers that captured tier
+        # buffers (the fused trainer's mesh-wide cold copy) compare their
+        # bound version against this and raise instead of serving stale
+        # rows (quiver_tpu.streaming's invalidation contract).
+        self.version = 0
 
     def _plan_split(self, n: int, f: int, itemsize: int, quantized: bool,
                     num_shards: int) -> tuple[int, int]:
@@ -744,6 +750,158 @@ class ShardedFeature(KernelChoice):
         rows = budget // max(row_bytes, 1)
         self._rep_ceiling_rows = max(self._rep_ceiling_rows, rows)
         self.resplit(rows)
+
+    # -- streaming mutation (transactional row updates) ----------------------
+
+    def apply_row_updates(self, ids, rows) -> None:
+        """Transactionally update feature rows across ALL THREE tiers.
+
+        ``ids`` are ORIGINAL node ids (translated through
+        ``feature_order`` — the same id space gathers use); ``rows`` is
+        the matching ``(U, feature_dim)`` block in the logical (float)
+        dtype. The update is all-or-nothing: every patched host array
+        (device region, cold rows, dequant scales for int8 storage) is
+        built and validated ASIDE, then published together with ONE
+        version bump; a validation failure leaves the store bit-identical.
+
+        Both device tiers re-place from the patched region, so an updated
+        row pinned in L0 serves the new value on EVERY chip and its L1
+        shard agrees — no stale L0 serve (the streaming layer's
+        invalidation contract). Consumers that captured tier buffers (the
+        fused trainer's mesh-wide cold copy) detect the bumped
+        ``version`` and must refresh instead of reading stale rows.
+        Quantized (int8) stores re-quantize the updated rows per-row and
+        patch their scales in the same transaction.
+        """
+        if self.shape is None:
+            raise ValueError("apply_row_updates() before from_cpu_tensor()")
+        n, f = self.shape
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        rows = np.asarray(rows)
+        if rows.ndim != 2 or rows.shape != (ids.shape[0], f):
+            raise ValueError(
+                f"rows must be ({ids.shape[0]}, {f}) to match ids/the "
+                f"store's feature dim, got {rows.shape}"
+            )
+        if ids.size == 0:
+            return
+        if ids.min() < 0 or ids.max() >= n:
+            raise ValueError(
+                f"update ids must be in [0, {n}); got range "
+                f"[{ids.min()}, {ids.max()}]"
+            )
+        if np.unique(ids).shape[0] != ids.shape[0]:
+            raise ValueError(
+                "duplicate ids in one row-update transaction are ambiguous "
+                "(which value wins?); collapse duplicates upstream — the "
+                "streaming layer's duplicate policy does this at admission"
+            )
+        if np.issubdtype(rows.dtype, np.floating) and not np.isfinite(
+                rows).all():
+            raise ValueError(
+                "row updates contain non-finite values; a poisoned row "
+                "must be rejected at the boundary, not cached"
+            )
+        quantized = (
+            self.storage_dtype is not None
+            and self.storage_dtype == np.dtype(np.int8)
+        )
+        # --- build every patched array ASIDE (pure numpy, no mutation) ---
+        if self.feature_order is not None:
+            t = np.asarray(self.feature_order).astype(np.int64)[ids]
+        else:
+            t = ids
+        if quantized:
+            new_rows, row_scale = quantize_rows_int8(
+                rows.astype(np.float32, copy=False)
+            )
+            new_scale = np.asarray(self.scale).copy()
+            new_scale[t] = row_scale
+        else:
+            new_rows = rows.astype(self.dtype, copy=False)
+            new_scale = None
+        device_rows = self.rep_rows + self.hot_rows
+        in_region = t < device_rows
+        new_region = None
+        if device_rows > 0 and bool(in_region.any()):
+            if self._region_host is not None:
+                new_region = self._region_host.copy()
+            else:
+                parts = []
+                if self.rep is not None:
+                    parts.append(np.asarray(self.rep))
+                if self.hot is not None:
+                    parts.append(np.asarray(self.hot.table)[: self.hot_rows])
+                new_region = (
+                    np.concatenate(parts) if len(parts) > 1 else
+                    parts[0].copy()
+                )
+            new_region[t[in_region]] = new_rows[in_region]
+        new_cold = None
+        if bool((~in_region).any()):
+            new_cold = np.asarray(self.cold).copy()
+            new_cold[t[~in_region] - device_rows] = new_rows[~in_region]
+        # --- publish: host state + ONE version bump, then re-place the
+        # device tiers from it (placements derive from the committed host
+        # arrays, so a placement retry reproduces the same state) ---
+        self.version += 1
+        if new_scale is not None:
+            self.scale = jnp.asarray(new_scale)
+        if new_region is not None:
+            if self._region_host is not None:
+                self._region_host = new_region
+            self._place_region(new_region, self.rep_rows)
+        if new_cold is not None:
+            old_cold = self.cold
+            self.cold, self._cold_is_host = to_pinned_host(
+                new_cold, mesh=self.mesh
+            )
+            if old_cold is not None and hasattr(old_cold, "delete"):
+                old_cold.delete()
+        # pre-update telemetry describes rows that no longer exist
+        self.last_tier_hits = None
+        get_logger("feature").info(
+            "feature row update v%d: %d rows (%d device-region, %d cold)%s",
+            self.version, ids.shape[0], int(in_region.sum()),
+            int((~in_region).sum()),
+            " + requantized scales" if quantized else "",
+        )
+
+    def note_degree_update(self, degree) -> None:
+        """Feed post-mutation degrees to the existing split tuner so
+        re-tiering follows mutation (ROADMAP item 3).
+
+        A committed topology mutation changes the degree distribution the
+        original L0/L1 boundary was planned from. This hands the NEW
+        per-node degrees to the SAME grow/shrink/dead-band tuner that
+        consumes measured tier hits (:meth:`_maybe_auto_split`), as a
+        synthetic per-tier "hit mass" vector — degree-as-heat, the
+        proxy the store's initial placement used. One boundary move per
+        commit, at most; measured traffic keeps tuning afterwards.
+        No-op unless ``auto_split=True`` (the tuner's own opt-in)."""
+        if self.shape is None or not self.auto_split \
+                or self._region_host is None:
+            return
+        n, _ = self.shape
+        degree = np.asarray(degree).reshape(-1)
+        if degree.shape[0] != n:
+            raise ValueError(
+                f"degree must have {n} entries, got {degree.shape[0]}"
+            )
+        if self.feature_order is not None:
+            # feature_order maps node id -> translated row; scatter the
+            # new degrees into translated row order
+            deg_t = np.zeros(n, dtype=np.int64)
+            deg_t[np.asarray(self.feature_order).astype(np.int64)] = degree
+        else:
+            deg_t = degree.astype(np.int64)
+        device_rows = self.rep_rows + self.hot_rows
+        self.last_tier_hits = np.array(
+            [deg_t[: self.rep_rows].sum(),
+             deg_t[self.rep_rows: device_rows].sum(),
+             deg_t[device_rows:].sum()],
+        )
+        self._maybe_auto_split()
 
     # graftlint: eager -- between-batch split tuner; under trace the hits
     def _maybe_auto_split(self) -> None:  # int() raises and except returns

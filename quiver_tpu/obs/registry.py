@@ -53,6 +53,9 @@ __all__ = [
     "PREFETCH_RETRIES",
     "PREFETCH_SKIPS",
     "DEGRADED_LOOKUPS",
+    "DELTAS_QUARANTINED",
+    "DELTAS_COMMITTED",
+    "STREAMING_COMMITS",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -72,6 +75,13 @@ GUARD_NONFINITE = "resilience.nonfinite_grads"
 PREFETCH_RETRIES = "prefetch.retries"
 PREFETCH_SKIPS = "prefetch.skipped_batches"
 DEGRADED_LOOKUPS = "resilience.degraded_lookups"
+# streaming mutation layer (quiver_tpu/streaming): delta batches rejected
+# at the ingestion boundary or by a failed commit (quarantined with a
+# reason, never partially applied), delta batches merged by a published
+# commit, and published commits (= version bumps)
+DELTAS_QUARANTINED = "streaming.deltas_quarantined"
+DELTAS_COMMITTED = "streaming.deltas_committed"
+STREAMING_COMMITS = "streaming.commits"
 
 _KINDS = ("counter", "gauge")
 

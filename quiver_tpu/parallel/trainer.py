@@ -395,6 +395,14 @@ class DistributedTrainer:
         _, self.caps = sampler._compiled(self.local_batch)
         self._step = self._build()
         self._epoch_fn = self._build_epoch()
+        # streaming-mutation versions this program is bound to: the step
+        # captured device operands (the topology arrays above, the
+        # mesh-wide cold copy) from the host state as of THESE versions;
+        # a quiver_tpu.streaming commit bumps them, after which
+        # dispatching the captured program would silently read the
+        # pre-commit graph/rows — step()/epoch_scan() raise instead
+        # (refresh() re-captures and re-binds)
+        self._bound_versions = self._current_versions()
 
     # -- telemetry views (API compatibility over the metrics registry) ------
 
@@ -433,6 +441,58 @@ class DistributedTrainer:
             self.metrics, self.timeline,
             "" if self.collect_metrics else "; collect_metrics=False",
         )
+
+    # -- streaming-mutation versioning --------------------------------------
+
+    def _current_versions(self) -> tuple[int, int]:
+        """(topology version, feature version) of the HOST state right
+        now — what a streaming commit bumps."""
+        return (
+            int(getattr(self.sampler.csr_topo, "version", 0)),
+            int(getattr(self.feature, "version", 0)),
+        )
+
+    def _check_versions(self) -> None:
+        """Raise instead of dispatching a program whose captured operands
+        predate a streaming commit (silent stale reads: the step would
+        sample the pre-commit topology and gather the pre-commit cold
+        rows)."""
+        current = self._current_versions()
+        if current != self._bound_versions:
+            from ..core.topology import VersionMismatchError
+
+            raise VersionMismatchError(
+                f"trainer program is bound to (topology, feature) "
+                f"versions {self._bound_versions} but the host state has "
+                f"committed {current}; call trainer.refresh() to "
+                f"re-capture the mutated state before training"
+            )
+
+    def refresh(self) -> "DistributedTrainer":
+        """Re-capture the trainer's device operands from the (mutated)
+        host state and rebuild the step/epoch programs — the consumer
+        side of a ``quiver_tpu.streaming`` commit.
+
+        Refreshes, in order: the sampler's device topology (via its own
+        ``refresh_topology`` seam, when stale), the trainer's captured
+        topology operands, the mesh-wide cold-tier copy, the compiled
+        step/epoch programs, and the bound versions. The mesh, the model,
+        the optimizer state layout, the seed packing, and the PRNG
+        discipline are untouched — only the graph/feature bytes the
+        programs read are re-pulled."""
+        if int(getattr(self.sampler.csr_topo, "version", 0)) != \
+                self.sampler._topo_version:
+            self.sampler.refresh_topology()
+        if self.topo_sharded:
+            self.topo = (self.sampler.topo.indptr, self.sampler.topo.indices)
+        else:
+            self.topo = self._mesh_wide_topo(self.sampler.topo)
+        self._cold = self._mesh_wide_host(self.feature.cold) if getattr(
+            self.feature, "_cold_is_host", False) else self.feature.cold
+        self._step = self._build()
+        self._epoch_fn = self._build_epoch()
+        self._bound_versions = self._current_versions()
+        return self
 
     # -- program ------------------------------------------------------------
 
@@ -811,6 +871,7 @@ class DistributedTrainer:
         before the next step's dispatch (the changed tier shapes re-key
         the jit cache, so the program retraces on the new split).
         """
+        self._check_versions()
         feature = self.feature
         plan = self.fault_plan
         step_idx = self._fault_step
@@ -928,6 +989,7 @@ class DistributedTrainer:
         :class:`~quiver_tpu.resilience.Preemption` once that step's chunk
         has run but before its checkpoint lands (the drill's "kill").
         """
+        self._check_versions()
         steps = int(np.shape(seed_mat)[0])
         start = int(start_step)
         if not 0 <= start <= steps:
@@ -1187,6 +1249,8 @@ class DistributedTrainer:
         )
         self._step = self._build()
         self._epoch_fn = self._build_epoch()
+        # the replanned programs captured the CURRENT host state
+        self._bound_versions = self._current_versions()
 
     # graftlint: eager -- between-batch tuner on host numpy telemetry; the
     def _maybe_grow_routed_alpha(self) -> None:  # step program never calls it

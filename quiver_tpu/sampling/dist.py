@@ -430,6 +430,7 @@ class DistGraphSageSampler(GraphSageSampler):
         sampler's own PRNG stream (each worker folds in its flat worker
         index on top).
         """
+        self.check_topo_version()
         seeds = np.asarray(input_nodes)
         batch = int(seeds.shape[0])
         if batch and (seeds.min() < 0

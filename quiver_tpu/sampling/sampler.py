@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.config import SampleMode
-from ..core.topology import CSRTopo, DeviceTopology
+from ..core.topology import CSRTopo, DeviceTopology, VersionMismatchError
 from ..ops.reindex import reindex_layer, resolve_dedup
 from ..ops.sample import sample_layer
 from ..utils.trace import trace_scope
@@ -288,6 +288,10 @@ class GraphSageSampler:
                 "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
             )
         self.topo = self._init_topo(device_topo)
+        # the committed mutation version the device placement reflects; a
+        # streaming commit bumps csr_topo.version, after which sampling
+        # raises VersionMismatchError until refresh_topology() re-places
+        self._topo_version = int(getattr(csr_topo, "version", 0))
         self._seed_capacity = seed_capacity
         self._auto_caps = frontier_caps == "auto"
         self._auto_margin = float(auto_margin)
@@ -345,6 +349,33 @@ class GraphSageSampler:
         return self.csr_topo.to_device(
             self.mode, with_eid=self.with_eid, with_weights=self.weighted
         )
+
+    # -- streaming-mutation versioning --------------------------------------
+
+    def check_topo_version(self) -> None:
+        """Raise :class:`VersionMismatchError` when the host CSR has been
+        mutated (a ``quiver_tpu.streaming`` commit bumped its version)
+        since this sampler's device topology was placed — sampling over
+        the stale placement would silently draw from the pre-commit
+        graph. Call :meth:`refresh_topology` to re-place."""
+        current = int(getattr(self.csr_topo, "version", 0))
+        if current != self._topo_version:
+            raise VersionMismatchError(
+                f"sampler topology placement is at version "
+                f"{self._topo_version} but the host CSR has committed "
+                f"version {current}; call refresh_topology() to re-place "
+                f"the device topology before sampling"
+            )
+
+    def refresh_topology(self) -> "GraphSageSampler":
+        """Re-place the device topology from the (possibly mutated) host
+        CSR and adopt its committed version. The compiled-program cache is
+        dropped — edge-array shapes changed with the edge count, and the
+        mesh-sharded override bakes partition geometry into the program."""
+        self.topo = self._init_topo(None)
+        self._topo_version = int(getattr(self.csr_topo, "version", 0))
+        self._compiled_cache.clear()
+        return self
 
     # -- static-shape planning ---------------------------------------------
 
@@ -412,6 +443,7 @@ class GraphSageSampler:
         matching the reference's ``adjs[::-1]`` return (sage_sampler.py:112);
         ``edge_counts``/``frontier_counts`` carry per-layer in-program tallies.
         """
+        self.check_topo_version()
         seeds = np.asarray(input_nodes)
         batch = int(seeds.shape[0])
         if batch and (seeds.min() < 0 or seeds.max() >= self.csr_topo.node_count):

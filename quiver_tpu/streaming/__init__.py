@@ -1,0 +1,48 @@
+"""Transactional streaming graph mutation (ROADMAP item 3).
+
+Production graphs mutate under traffic; the resident state this framework
+keeps per chip — the (sharded) CSR topology, the three-tier feature store,
+the trainer's captured operands — must evolve WITHOUT a full rebuild and
+without ever exposing a half-applied or corrupt update. This package is
+that machinery:
+
+* :class:`DeltaBatch` + admission validation (``delta.py``) — the
+  ingestion boundary; malformed batches are quarantined whole with a
+  reason (``streaming.deltas_quarantined``), never partially applied.
+* :class:`StreamingGraph` (``commit.py``) — staging, atomic
+  epoch-boundary commits (merge aside → verify invariants → publish with
+  ONE version bump), bit-identical rollback on any failure.
+* Versioned invalidation — committed versions thread through
+  ``CSRTopo``/``ShardedTopology``/``ShardedFeature`` and their consumers
+  (samplers, ``DistributedTrainer``), which raise
+  :class:`VersionMismatchError` instead of serving stale reads until
+  their ``refresh`` seams re-place.
+
+The drillable failure modes live in ``benchmarks/chaos.py`` (``mutate``
+drill); the incremental-vs-rebuild bit-parity differential in
+``tests/test_streaming.py``.
+"""
+
+from ..core.topology import VersionMismatchError
+from .commit import (
+    CommitAborted,
+    CommitResult,
+    QuarantineRecord,
+    StreamingGraph,
+    merge_csr,
+    verify_merged_csr,
+)
+from .delta import DeltaBatch, DeltaRejected, validate_delta
+
+__all__ = [
+    "CommitAborted",
+    "CommitResult",
+    "DeltaBatch",
+    "DeltaRejected",
+    "QuarantineRecord",
+    "StreamingGraph",
+    "VersionMismatchError",
+    "merge_csr",
+    "validate_delta",
+    "verify_merged_csr",
+]

@@ -1,0 +1,519 @@
+"""Atomic epoch-boundary commits of staged deltas.
+
+The commit protocol (the transactional half of ROADMAP item 3):
+
+1. **Merge aside** — :func:`merge_csr` builds the post-mutation
+   ``indptr``/``indices`` as FRESH arrays; the committed CSR is never
+   touched. Per row, surviving old neighbors keep their slot order and
+   inserts append in ingestion order — exactly the layout a full
+   ``CSRTopo(edge_index=final_coo)`` rebuild produces (stable argsort),
+   which is what makes the incremental path bit-identical to a rebuild
+   (the acceptance differential). Deletes remove the EARLIEST matching
+   occurrences (old slots first, then staged inserts in order).
+2. **Verify** — :func:`verify_merged_csr` re-derives every post-merge
+   invariant from scratch: indptr starts at 0 and is monotone, the edge
+   arithmetic ``E' = E + inserts - deletes`` holds, every neighbor id is
+   in range, the node count is unchanged (so the contiguous owner map
+   ``v // rows_per_shard`` of every sharded consumer covers every row by
+   construction), and the UNTOUCHED rows' adjacency bytes checksum
+   (CRC32) identically to the pre-merge arrays — a merge bug cannot
+   corrupt rows the deltas never named.
+3. **Publish** — one call into ``CSRTopo._publish_mutation`` (a handful
+   of reference assignments) swaps the verified arrays in and bumps the
+   version ONCE; prepared feature-row updates publish through
+   ``ShardedFeature.apply_row_updates`` under the same transaction.
+   Consumers holding device placements of the old version
+   (samplers, trainers) raise
+   :class:`~quiver_tpu.core.topology.VersionMismatchError` instead of
+   serving stale reads, until their ``refresh`` seams re-place.
+
+ANY failure before publish aborts the whole transaction: the staged
+batches are quarantined with the reason (``streaming.deltas_quarantined``
+on the graftscope registry), the committed state is untouched
+bit-identically, and :class:`CommitAborted` propagates to the caller.
+``commit(inject_failure=)`` is the deterministic chaos seam (the
+FaultPlan discipline): it forces the abort path at a named stage so the
+rollback contract is drillable (benchmarks/chaos.py ``mutate``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+
+import numpy as np
+
+from ..core.topology import CSRTopo, VersionMismatchError
+from ..obs.registry import (
+    DELTAS_COMMITTED,
+    DELTAS_QUARANTINED,
+    STREAMING_COMMITS,
+    MetricsRegistry,
+)
+from ..utils.trace import get_logger
+from .delta import DeltaBatch, DeltaRejected, encode_pairs, validate_delta
+
+__all__ = [
+    "CommitAborted",
+    "CommitResult",
+    "QuarantineRecord",
+    "StreamingGraph",
+    "merge_csr",
+    "verify_merged_csr",
+]
+
+
+class CommitAborted(RuntimeError):
+    """A commit failed before publish. The pre-commit state is intact
+    bit-identically (nothing was applied); the staged batches were
+    quarantined with the failure reason."""
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineRecord:
+    """One quarantined ingestion/commit failure: where it failed
+    (``stage``: "ingest" or "commit"), why, and the offending batches."""
+
+    stage: str
+    reason: str
+    deltas: tuple[DeltaBatch, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CommitResult:
+    """Summary of one published commit."""
+
+    version: int
+    batches: int
+    edges_inserted: int
+    edges_deleted: int
+    rows_updated: int
+    edge_count: int
+
+
+def merge_csr(indptr: np.ndarray, indices: np.ndarray,
+              inserts: np.ndarray | None, deletes: np.ndarray | None):
+    """Merge COO edge inserts/deletes into fresh CSR arrays.
+
+    Returns ``(new_indptr, new_indices, touched)`` where ``touched`` is
+    the boolean per-row mask of rows whose adjacency changed. The input
+    arrays are read-only; untouched rows are copied verbatim in
+    contiguous runs.
+    """
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices)
+    n = int(indptr.shape[0] - 1)
+    deg = np.diff(indptr)
+
+    ins_by_row: dict[int, list[int]] = {}
+    if inserts is not None and inserts.shape[1]:
+        for s, d in zip(inserts[0].tolist(), inserts[1].tolist()):
+            ins_by_row.setdefault(int(s), []).append(int(d))
+    del_by_row: dict[int, dict[int, int]] = {}
+    if deletes is not None and deletes.shape[1]:
+        for s, d in zip(deletes[0].tolist(), deletes[1].tolist()):
+            cnt = del_by_row.setdefault(int(s), {})
+            cnt[int(d)] = cnt.get(int(d), 0) + 1
+
+    touched = np.zeros(n, dtype=bool)
+    for r in ins_by_row:
+        touched[r] = True
+    for r in del_by_row:
+        touched[r] = True
+
+    new_deg = deg.copy()
+    for r in ins_by_row:
+        new_deg[r] += len(ins_by_row[r])
+    for r, cnt in del_by_row.items():
+        new_deg[r] -= sum(cnt.values())
+    if (new_deg < 0).any():
+        bad = int(np.argwhere(new_deg < 0)[0, 0])
+        raise DeltaRejected(
+            f"row {bad} would end with negative degree after deletes — "
+            f"more deletes than live edges (admission should have caught "
+            f"this; the staged set is inconsistent)"
+        )
+    new_indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(new_deg, out=new_indptr[1:])
+    new_indices = np.empty(int(new_indptr[-1]), dtype=np.int64)
+
+    touched_rows = np.flatnonzero(touched)
+    # copy untouched spans between consecutive touched rows in single
+    # slices; rebuild only the touched rows in Python (O(touched))
+    prev = 0
+    for r in touched_rows.tolist():
+        if r > prev:  # untouched run [prev, r)
+            new_indices[new_indptr[prev]:new_indptr[r]] = \
+                indices[indptr[prev]:indptr[r]]
+        old = indices[indptr[r]:indptr[r + 1]].tolist()
+        pending = dict(del_by_row.get(r, {}))
+        kept = []
+        for v in old:
+            if pending.get(v, 0) > 0:
+                pending[v] -= 1  # earliest occurrence removed first
+            else:
+                kept.append(v)
+        for v in ins_by_row.get(r, ()):  # inserts append, ingestion order
+            if pending.get(v, 0) > 0:
+                pending[v] -= 1  # delete staged after the insert it names
+            else:
+                kept.append(v)
+        new_indices[new_indptr[r]:new_indptr[r + 1]] = kept
+        prev = r + 1
+    if prev < n:
+        new_indices[new_indptr[prev]:] = indices[indptr[prev]:]
+    return new_indptr, new_indices, touched
+
+
+def _untouched_crc(indptr: np.ndarray, indices: np.ndarray,
+                   touched: np.ndarray) -> int:
+    """CRC32 over the concatenated adjacency bytes of untouched rows
+    (canonical int64), streamed span by span."""
+    crc = 0
+    n = int(indptr.shape[0] - 1)
+    prev = 0
+    for r in np.flatnonzero(touched).tolist():
+        if r > prev:
+            span = np.ascontiguousarray(
+                indices[int(indptr[prev]):int(indptr[r])], dtype=np.int64
+            )
+            crc = zlib.crc32(span.tobytes(), crc)
+        prev = r + 1
+    if prev < n:
+        span = np.ascontiguousarray(
+            indices[int(indptr[prev]):], dtype=np.int64
+        )
+        crc = zlib.crc32(span.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def verify_merged_csr(old_indptr, old_indices, new_indptr, new_indices,
+                      touched: np.ndarray, inserted: int,
+                      deleted: int) -> None:
+    """Re-derive every post-merge invariant; raise :class:`DeltaRejected`
+    naming the first violation. Independent of :func:`merge_csr`'s
+    internals on purpose — it re-checks the OUTPUT arrays from scratch,
+    so a merge bug is caught here rather than published."""
+    old_indptr = np.asarray(old_indptr, dtype=np.int64)
+    new_indptr = np.asarray(new_indptr, dtype=np.int64)
+    n = int(old_indptr.shape[0] - 1)
+    if int(new_indptr.shape[0] - 1) != n:
+        raise DeltaRejected(
+            f"post-merge node count {int(new_indptr.shape[0] - 1)} != {n}: "
+            f"the owner map of every sharded consumer would break"
+        )
+    if int(new_indptr[0]) != 0:
+        raise DeltaRejected("post-merge indptr does not start at 0")
+    if (np.diff(new_indptr) < 0).any():
+        bad = int(np.argwhere(np.diff(new_indptr) < 0)[0, 0])
+        raise DeltaRejected(
+            f"post-merge indptr is not monotone at row {bad}"
+        )
+    if int(new_indptr[-1]) != new_indices.shape[0]:
+        raise DeltaRejected(
+            f"post-merge indptr[-1]={int(new_indptr[-1])} != "
+            f"len(indices)={new_indices.shape[0]}"
+        )
+    expected = int(old_indptr[-1]) + int(inserted) - int(deleted)
+    if int(new_indptr[-1]) != expected:
+        raise DeltaRejected(
+            f"edge-count arithmetic failed: {int(old_indptr[-1])} + "
+            f"{inserted} - {deleted} = {expected}, merge produced "
+            f"{int(new_indptr[-1])}"
+        )
+    if new_indices.size:
+        lo, hi = int(new_indices.min()), int(new_indices.max())
+        if lo < 0 or hi >= n:
+            raise DeltaRejected(
+                f"post-merge indices reference node ids outside "
+                f"[0, {n}) (range [{lo}, {hi}])"
+            )
+    # untouched rows: degree AND content byte-identical to pre-merge
+    un = ~np.asarray(touched, dtype=bool)
+    if not np.array_equal(np.diff(old_indptr)[un], np.diff(new_indptr)[un]):
+        bad = int(np.flatnonzero(
+            un & (np.diff(old_indptr) != np.diff(new_indptr))
+        )[0])
+        raise DeltaRejected(
+            f"untouched row {bad} changed degree — the merge leaked "
+            f"outside the delta's footprint"
+        )
+    old_crc = _untouched_crc(old_indptr, old_indices, touched)
+    new_crc = _untouched_crc(new_indptr, new_indices, touched)
+    if old_crc != new_crc:
+        raise DeltaRejected(
+            f"untouched-range checksum mismatch (pre {old_crc:#x} vs "
+            f"post {new_crc:#x}) — the merge corrupted rows the deltas "
+            f"never named"
+        )
+
+
+_FAIL_STAGES = ("merge", "verify", "features")
+
+
+class StreamingGraph:
+    """Transactional mutation coordinator for resident graph state.
+
+    Owns the staging buffer, the admission boundary, the quarantine log,
+    and the atomic commit of staged deltas into a :class:`CSRTopo` (and,
+    when attached, a :class:`~quiver_tpu.feature.shard.ShardedFeature`'s
+    rows). Device-side consumers (samplers, trainers) are NOT mutated
+    here — they detect the published version bump through their own
+    version checks and re-place via their ``refresh`` seams; see the
+    module docstring for the protocol.
+
+    Args:
+      csr_topo: the committed host CSR. Weighted topologies and
+        ``eid``-tracking consumers are rejected (mutation drops COO
+        provenance; weights do not survive a merge).
+      feature: optional ShardedFeature whose rows feature deltas update
+        (row updates publish in the same transaction as the topology
+        merge; its ``note_degree_update`` re-tiering hook runs after a
+        commit that changed degrees).
+      duplicates: admission duplicate policy — ``"error"`` (default)
+        rejects duplicate edge inserts / update ids per batch;
+        ``"allow"`` admits parallel edges and collapses duplicate update
+        ids last-wins.
+    """
+
+    def __init__(self, csr_topo: CSRTopo, feature=None,
+                 duplicates: str = "error"):
+        if duplicates not in ("error", "allow"):
+            raise ValueError(
+                f"duplicates must be 'error' or 'allow', got {duplicates!r}"
+            )
+        if csr_topo.edge_weight is not None:
+            raise NotImplementedError(
+                "streaming mutation of a weighted topology is not "
+                "supported (per-edge weights do not survive the merge); "
+                "mutate the unweighted CSR and re-attach weights"
+            )
+        self.csr_topo = csr_topo
+        self.feature = feature
+        if feature is not None and not hasattr(feature, "apply_row_updates"):
+            raise ValueError(
+                "feature must support transactional row updates "
+                "(ShardedFeature.apply_row_updates); got "
+                f"{type(feature).__name__}"
+            )
+        self.duplicates = duplicates
+        self._staged: list[DeltaBatch] = []
+        self.quarantined: list[QuarantineRecord] = []
+        self._quarantined_total = 0
+        self._committed_total = 0
+        self._commits_total = 0
+        self.metrics = MetricsRegistry()
+        self.metrics.counter(
+            DELTAS_QUARANTINED, unit="batches",
+            doc="delta batches rejected at admission or by a failed "
+                "commit (quarantined with a reason, never applied)",
+        )
+        self.metrics.counter(
+            DELTAS_COMMITTED, unit="batches",
+            doc="delta batches merged by a published commit",
+        )
+        self.metrics.counter(
+            STREAMING_COMMITS, unit="commits",
+            doc="published commits (= version bumps)",
+        )
+
+    # -- staging ------------------------------------------------------------
+
+    @property
+    def staged(self) -> tuple[DeltaBatch, ...]:
+        """The admitted, not-yet-committed batches (read-only view)."""
+        return tuple(self._staged)
+
+    def staged_counts(self) -> tuple[int, int, int]:
+        """Total staged (edge inserts, edge deletes, row updates)."""
+        ei = ed = u = 0
+        for d in self._staged:
+            a, b, c = d.counts()
+            ei, ed, u = ei + a, ed + b, u + c
+        return ei, ed, u
+
+    def _live_pair_counts(self) -> dict[int, int]:
+        """Encoded-pair multiset of live edges: the committed CSR
+        adjusted by the already-staged inserts/deletes — what a new
+        batch's deletes must exist in."""
+        n = self.csr_topo.node_count
+        indptr = np.asarray(self.csr_topo.indptr, dtype=np.int64)
+        indices = np.asarray(self.csr_topo.indices, dtype=np.int64)
+        src = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+        keys, cnts = np.unique(
+            encode_pairs(src, indices, n), return_counts=True
+        )
+        live = dict(zip(keys.tolist(), cnts.tolist()))
+        for d in self._staged:
+            if d.edge_inserts is not None and d.edge_inserts.shape[1]:
+                for k in encode_pairs(
+                        d.edge_inserts[0], d.edge_inserts[1], n).tolist():
+                    live[k] = live.get(k, 0) + 1
+            if d.edge_deletes is not None and d.edge_deletes.shape[1]:
+                for k in encode_pairs(
+                        d.edge_deletes[0], d.edge_deletes[1], n).tolist():
+                    live[k] = live.get(k, 0) - 1
+        return live
+
+    def _quarantine(self, stage: str, reason: str,
+                    deltas: tuple[DeltaBatch, ...]) -> None:
+        self.quarantined.append(QuarantineRecord(stage, reason, deltas))
+        self._quarantined_total += len(deltas)
+        self.metrics.set(
+            DELTAS_QUARANTINED, np.int32(self._quarantined_total)
+        )
+        get_logger("streaming").warning(
+            "quarantined %d delta batch(es) at %s: %s",
+            len(deltas), stage, reason,
+        )
+
+    def ingest(self, delta: DeltaBatch) -> bool:
+        """Admission-validate ``delta`` and stage it for the next commit.
+
+        Returns True when staged; on ANY failing check the batch is
+        quarantined whole with the reason (``quarantined`` log +
+        ``streaming.deltas_quarantined``) and False returns — a rejected
+        batch is never partially staged, and the duplicate/existence
+        accounting already includes earlier staged batches."""
+        try:
+            if not isinstance(delta, DeltaBatch):
+                raise DeltaRejected(
+                    f"expected a DeltaBatch, got {type(delta).__name__}"
+                )
+            fs = None
+            if self.feature is not None:
+                fs = self.feature.shape
+            normalized = validate_delta(
+                delta, self.csr_topo.node_count, fs,
+                live_pair_counts=self._live_pair_counts(),
+                duplicates=self.duplicates,
+            )
+        except DeltaRejected as e:
+            self._quarantine("ingest", str(e), (delta,))
+            return False
+        self._staged.append(normalized)
+        return True
+
+    # -- commit -------------------------------------------------------------
+
+    def _collapse_updates(self):
+        """Fold the staged batches' feature updates into one last-wins
+        (id, rows) pair in first-touch order — the same outcome as
+        applying the batches sequentially."""
+        merged: dict[int, np.ndarray] = {}
+        for d in self._staged:
+            if d.update_ids is None:
+                continue
+            for i, node in enumerate(d.update_ids.tolist()):
+                merged[int(node)] = d.update_rows[i]
+        if not merged:
+            return None, None
+        ids = np.fromiter(merged.keys(), dtype=np.int64, count=len(merged))
+        rows = np.stack([merged[int(i)] for i in ids])
+        return ids, rows
+
+    def commit(self, inject_failure: str | None = None) -> CommitResult | None:
+        """Atomically publish every staged batch; returns the
+        :class:`CommitResult` (or None when nothing is staged).
+
+        All-or-nothing: the merged CSR and the collapsed feature updates
+        are built and VERIFIED aside, then published with one version
+        bump each (topology, feature). Any failure before publish
+        quarantines the whole staged set with the reason, leaves the
+        committed state bit-identical, and raises :class:`CommitAborted`.
+        After a successful commit the updated degrees feed the attached
+        store's re-tiering hook (``note_degree_update``), and stale
+        consumers raise ``VersionMismatchError`` until refreshed.
+
+        ``inject_failure`` is the deterministic chaos seam (FaultPlan
+        discipline, drilled by ``benchmarks/chaos.py mutate``): force the
+        abort path at stage ``"merge"``, ``"verify"``, or ``"features"``
+        — i.e. a crash at ANY point before publish — and observe the old
+        version intact.
+        """
+        if inject_failure is not None and inject_failure not in _FAIL_STAGES:
+            raise ValueError(
+                f"inject_failure must be one of {_FAIL_STAGES}, "
+                f"got {inject_failure!r}"
+            )
+        if not self._staged:
+            return None
+        staged = tuple(self._staged)
+        topo = self.csr_topo
+        try:
+            ins_parts = [d.edge_inserts for d in staged
+                         if d.edge_inserts is not None
+                         and d.edge_inserts.shape[1]]
+            del_parts = [d.edge_deletes for d in staged
+                         if d.edge_deletes is not None
+                         and d.edge_deletes.shape[1]]
+            inserts = np.concatenate(ins_parts, axis=1) if ins_parts else None
+            deletes = np.concatenate(del_parts, axis=1) if del_parts else None
+            n_ins = 0 if inserts is None else int(inserts.shape[1])
+            n_del = 0 if deletes is None else int(deletes.shape[1])
+            old_indptr = np.asarray(topo.indptr, dtype=np.int64)
+            old_indices = np.asarray(topo.indices)
+            topo_changed = bool(n_ins or n_del)
+            if inject_failure == "merge":
+                raise DeltaRejected(
+                    "injected commit failure at stage 'merge' (chaos seam)"
+                )
+            if topo_changed:
+                new_indptr, new_indices, touched = merge_csr(
+                    old_indptr, old_indices, inserts, deletes
+                )
+            else:
+                new_indptr, new_indices = old_indptr, old_indices
+                touched = np.zeros(topo.node_count, dtype=bool)
+            if inject_failure == "verify":
+                raise DeltaRejected(
+                    "injected commit failure at stage 'verify' (chaos seam)"
+                )
+            if topo_changed:
+                verify_merged_csr(
+                    old_indptr, old_indices, new_indptr, new_indices,
+                    touched, n_ins, n_del,
+                )
+            upd_ids, upd_rows = self._collapse_updates()
+            if inject_failure == "features":
+                raise DeltaRejected(
+                    "injected commit failure at stage 'features' "
+                    "(chaos seam)"
+                )
+        except (DeltaRejected, ValueError, VersionMismatchError) as e:
+            self._staged.clear()
+            self._quarantine("commit", str(e), staged)
+            raise CommitAborted(
+                f"commit of {len(staged)} staged batch(es) aborted before "
+                f"publish: {e} (pre-commit state intact; batches "
+                f"quarantined)"
+            ) from e
+        # ---- publish: everything above is verified and aside ----
+        if topo_changed:
+            topo._publish_mutation(new_indptr, new_indices)
+        if upd_ids is not None:
+            self.feature.apply_row_updates(upd_ids, upd_rows)
+        self._staged.clear()
+        self._committed_total += len(staged)
+        self._commits_total += 1
+        self.metrics.set(DELTAS_COMMITTED, np.int32(self._committed_total))
+        self.metrics.set(STREAMING_COMMITS, np.int32(self._commits_total))
+        if topo_changed and self.feature is not None:
+            # re-tiering follows mutation: the new degree distribution
+            # feeds the store's existing split tuner
+            self.feature.note_degree_update(topo.degree)
+        result = CommitResult(
+            version=topo.version,
+            batches=len(staged),
+            edges_inserted=n_ins,
+            edges_deleted=n_del,
+            rows_updated=0 if upd_ids is None else int(upd_ids.shape[0]),
+            edge_count=topo.edge_count,
+        )
+        get_logger("streaming").info(
+            "committed v%d: %d batch(es), +%d/-%d edges (E=%d), %d row "
+            "update(s); stale consumers must refresh",
+            result.version, result.batches, n_ins, n_del,
+            result.edge_count, result.rows_updated,
+        )
+        return result

@@ -1,0 +1,225 @@
+"""Delta batches and their admission validation.
+
+A :class:`DeltaBatch` is the unit of streaming graph mutation: edge
+inserts, edge deletes, and feature row updates that arrived together and
+must land together. Admission (:func:`validate_delta`) is the ingestion
+boundary of the transactional layer: every structural and semantic check
+runs BEFORE a batch is staged, so a malformed batch is quarantined whole —
+it can never be partially applied, and nothing downstream (the commit
+merge, the device placements) ever sees invalid state. The checks, in
+order:
+
+* **structure** — edge arrays are ``(2, E)`` integer COO, update ids are
+  1-D integers with a matching ``(U, feature_dim)`` float row block;
+* **range** — every edge endpoint and update id lies in
+  ``[0, node_count)`` (streaming deltas never add or remove nodes — the
+  owner map ``v // rows_per_shard`` of every sharded consumer stays valid
+  by construction, the invariant Zeng et al. (arXiv:2010.03166) scale-out
+  partitioning assumes);
+* **non-finite scan** — a NaN/Inf feature row is rejected here, not
+  cached and served;
+* **duplicate policy** — WITHIN one batch, duplicate edge inserts and
+  duplicate update ids are rejected under ``duplicates="error"`` (the
+  default) or collapsed/allowed under ``"allow"`` (updates: last wins).
+  Inserts that parallel an edge already in the graph are always
+  admitted — COO-built reference graphs are multigraphs;
+* **delete existence** — every delete must match a live edge in the
+  current committed CSR plus the already-staged deltas (multiset
+  accounting, so an insert staged earlier in the same window can be
+  deleted later in it).
+
+A failing check raises :class:`DeltaRejected` with the reason; the
+:class:`~quiver_tpu.streaming.commit.StreamingGraph` catches it, records
+a quarantine entry, and counts ``streaming.deltas_quarantined``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["DeltaBatch", "DeltaRejected", "validate_delta", "encode_pairs"]
+
+
+class DeltaRejected(ValueError):
+    """A delta batch failed admission (or its commit failed verification)
+    and was quarantined with this reason. The batch was never — and will
+    never be — applied, in whole or in part."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaBatch:
+    """One atomic unit of streaming mutation.
+
+    ``edge_inserts`` / ``edge_deletes`` are ``(2, E)`` COO arrays
+    (``[0]`` = source row, ``[1]`` = destination) over the EXISTING node
+    id space; ``update_ids``/``update_rows`` are the feature rows to
+    overwrite (original node ids + their new ``(U, feature_dim)``
+    values). Any field may be ``None``. ``tag`` labels the batch in
+    quarantine records and logs.
+    """
+
+    edge_inserts: np.ndarray | None = None
+    edge_deletes: np.ndarray | None = None
+    update_ids: np.ndarray | None = None
+    update_rows: np.ndarray | None = None
+    tag: str = ""
+
+    def counts(self) -> tuple[int, int, int]:
+        """(edge inserts, edge deletes, feature row updates)."""
+        ei = 0 if self.edge_inserts is None else self.edge_inserts.shape[1]
+        ed = 0 if self.edge_deletes is None else self.edge_deletes.shape[1]
+        u = 0 if self.update_ids is None else self.update_ids.shape[0]
+        return int(ei), int(ed), int(u)
+
+    def __repr__(self):
+        ei, ed, u = self.counts()
+        tag = f" tag={self.tag!r}" if self.tag else ""
+        return f"DeltaBatch(+{ei}e, -{ed}e, ~{u}rows{tag})"
+
+
+def encode_pairs(src: np.ndarray, dst: np.ndarray, n: int) -> np.ndarray:
+    """Encode (src, dst) edge endpoints as single int64 keys for multiset
+    accounting (``src * n + dst`` — exact for ``n`` up to the int32 node
+    id ceiling, since ``n**2 < 2**63``)."""
+    return src.astype(np.int64) * np.int64(n) + dst.astype(np.int64)
+
+
+def _as_edge_array(arr, what: str) -> np.ndarray:
+    arr = np.asarray(arr)
+    if arr.ndim != 2 or arr.shape[0] != 2:
+        raise DeltaRejected(
+            f"{what} must be a (2, E) COO array, got shape {arr.shape}"
+        )
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise DeltaRejected(
+            f"{what} must carry integer node ids, got dtype {arr.dtype}"
+        )
+    return arr.astype(np.int64, copy=False)
+
+
+def _check_range(arr: np.ndarray, n: int, what: str) -> None:
+    if arr.size == 0:
+        return
+    lo, hi = int(arr.min()), int(arr.max())
+    if lo < 0 or hi >= n:
+        raise DeltaRejected(
+            f"{what} reference node ids outside [0, {n}) "
+            f"(range [{lo}, {hi}]); streaming deltas never add nodes"
+        )
+
+
+def validate_delta(
+    delta: DeltaBatch,
+    node_count: int,
+    feature_shape: tuple[int, int] | None,
+    *,
+    live_pair_counts: dict[int, int] | None = None,
+    duplicates: str = "error",
+) -> DeltaBatch:
+    """Admission-validate ``delta``; return the normalized batch or raise
+    :class:`DeltaRejected` naming the first failing check.
+
+    ``feature_shape`` is the attached store's ``(n, feature_dim)`` (None
+    = no feature store, so feature updates are inadmissible).
+    ``live_pair_counts`` is the encoded-pair multiset of live edges
+    (committed CSR adjusted by already-staged deltas) that delete
+    existence is checked against; None skips the existence check (the
+    caller owns it). ``duplicates`` is the duplicate policy: ``"error"``
+    rejects duplicate edge inserts and duplicate update ids; ``"allow"``
+    admits parallel edges and collapses duplicate update ids last-wins.
+    """
+    if duplicates not in ("error", "allow"):
+        raise ValueError(
+            f"duplicates must be 'error' or 'allow', got {duplicates!r}"
+        )
+    n = int(node_count)
+    ins = dele = ids = rows = None
+
+    if delta.edge_inserts is not None:
+        ins = _as_edge_array(delta.edge_inserts, "edge_inserts")
+        _check_range(ins, n, "edge_inserts")
+        if duplicates == "error" and ins.shape[1]:
+            keys = encode_pairs(ins[0], ins[1], n)
+            uniq, cnt = np.unique(keys, return_counts=True)
+            if (cnt > 1).any():
+                k = int(uniq[np.argmax(cnt)])
+                raise DeltaRejected(
+                    f"duplicate edge insert ({k // n}, {k % n}) in one "
+                    f"batch (duplicates='error'; pass 'allow' for "
+                    f"parallel edges)"
+                )
+
+    if delta.edge_deletes is not None:
+        dele = _as_edge_array(delta.edge_deletes, "edge_deletes")
+        _check_range(dele, n, "edge_deletes")
+
+    if (delta.update_ids is None) != (delta.update_rows is None):
+        raise DeltaRejected(
+            "update_ids and update_rows must be passed together"
+        )
+    if delta.update_ids is not None:
+        if feature_shape is None:
+            raise DeltaRejected(
+                "delta carries feature row updates but no feature store "
+                "is attached to the streaming graph"
+            )
+        ids = np.asarray(delta.update_ids).reshape(-1)
+        if not np.issubdtype(ids.dtype, np.integer):
+            raise DeltaRejected(
+                f"update_ids must be integers, got dtype {ids.dtype}"
+            )
+        ids = ids.astype(np.int64, copy=False)
+        _check_range(ids, min(n, int(feature_shape[0])), "update_ids")
+        rows = np.asarray(delta.update_rows)
+        f = int(feature_shape[1])
+        if rows.ndim != 2 or rows.shape != (ids.shape[0], f):
+            raise DeltaRejected(
+                f"update_rows must be ({ids.shape[0]}, {f}) to match "
+                f"update_ids and the store's feature dim, got {rows.shape}"
+            )
+        if not np.issubdtype(rows.dtype, np.floating):
+            raise DeltaRejected(
+                f"update_rows must be float rows, got dtype {rows.dtype}"
+            )
+        if rows.size and not np.isfinite(rows).all():
+            bad = int(np.argwhere(~np.isfinite(rows).all(axis=1))[0, 0])
+            raise DeltaRejected(
+                f"update_rows contain non-finite values (first bad row: "
+                f"update index {bad}, node {int(ids[bad])}); a poisoned "
+                f"row is rejected at the boundary, not cached and served"
+            )
+        if ids.size and np.unique(ids).shape[0] != ids.shape[0]:
+            if duplicates == "error":
+                raise DeltaRejected(
+                    "duplicate update_ids in one batch "
+                    "(duplicates='error'; pass 'allow' for last-wins)"
+                )
+            # last-wins collapse: keep the LAST occurrence of each id
+            _, last = np.unique(ids[::-1], return_index=True)
+            keep = np.sort(ids.shape[0] - 1 - last)
+            ids, rows = ids[keep], rows[keep]
+
+    # delete existence against the committed-plus-staged multiset: every
+    # delete must name a live edge; over-deleting is a whole-batch reject
+    if dele is not None and dele.shape[1] and live_pair_counts is not None:
+        keys = encode_pairs(dele[0], dele[1], n)
+        avail = dict(live_pair_counts)
+        if ins is not None and ins.shape[1]:
+            for k in encode_pairs(ins[0], ins[1], n).tolist():
+                avail[k] = avail.get(k, 0) + 1
+        for k in keys.tolist():
+            have = avail.get(k, 0)
+            if have <= 0:
+                raise DeltaRejected(
+                    f"edge delete ({k // n}, {k % n}) does not match a "
+                    f"live edge (committed + staged); deletes must name "
+                    f"existing edges"
+                )
+            avail[k] = have - 1
+
+    return DeltaBatch(
+        edge_inserts=ins, edge_deletes=dele,
+        update_ids=ids, update_rows=rows, tag=delta.tag,
+    )

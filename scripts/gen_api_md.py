@@ -43,6 +43,9 @@ SECTIONS = [
      "Elastic mesh resilience — cross-mesh resume, circuit breaker"),
     ("quiver_tpu.resilience.integrity",
      "Checkpoint integrity — manifest schema, checksums, verification"),
+    ("quiver_tpu.streaming",
+     "Transactional streaming graph mutation — delta ingestion, atomic "
+     "commits, versioned invalidation"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),

@@ -1,0 +1,635 @@
+"""Transactional streaming graph mutation (quiver_tpu/streaming).
+
+Fast lane: admission/quarantine semantics, the merge-vs-rebuild bitwise
+oracle, rollback on injected commit failures, versioned invalidation in
+the samplers and the fused trainer, three-tier feature row updates
+(including the no-stale-L0 contract and the replan interaction), and the
+CSRTopo save/load hardening satellites.
+
+Slow lane: the end-to-end differential — train N epochs with deltas
+committed at epoch boundaries vs a full rebuild from the equivalent final
+graph (same sampled batches and loss trajectory, bitwise), plus the
+mid-commit-crash continuation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import (
+    CommitAborted,
+    CSRTopo,
+    DeltaBatch,
+    GraphSageSampler,
+    StreamingGraph,
+    VersionMismatchError,
+)
+from quiver_tpu.obs.registry import (
+    DELTAS_COMMITTED,
+    DELTAS_QUARANTINED,
+    STREAMING_COMMITS,
+)
+from quiver_tpu.streaming import DeltaRejected, merge_csr, verify_merged_csr
+
+
+def _graph(n=200, e=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    coo = rng.integers(0, n, size=(2, e)).astype(np.int64)
+    return CSRTopo(edge_index=coo), coo
+
+
+def _first_live_edge(topo):
+    src = int(np.repeat(np.arange(topo.node_count), topo.degree)[0])
+    dst = int(np.asarray(topo.indices)[
+        int(np.asarray(topo.indptr, dtype=np.int64)[src])])
+    return src, dst
+
+
+def _missing_pair(topo, coo):
+    """A (src, dst) pair guaranteed absent from the graph."""
+    n = topo.node_count
+    live = set((coo[0] * n + coo[1]).tolist())
+    for k in range(n * n):
+        if k not in live:
+            return k // n, k % n
+    raise AssertionError("graph is complete")
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_admission_rejects_and_quarantines():
+    topo, coo = _graph()
+    n = topo.node_count
+    sg = StreamingGraph(topo)
+    ms, md = _missing_pair(topo, coo)
+    bad = [
+        (DeltaBatch(edge_inserts=np.array([[0], [n + 3]])), "outside"),
+        (DeltaBatch(edge_inserts=np.array([[0, 1]])), "(2, E)"),
+        (DeltaBatch(edge_inserts=np.array([[0.5], [1.5]])), "integer"),
+        (DeltaBatch(edge_deletes=np.array([[ms], [md]])), "live edge"),
+        (DeltaBatch(update_ids=np.array([0]),
+                    update_rows=np.ones((1, 4), np.float32)),
+         "no feature store"),
+        (DeltaBatch(edge_inserts=np.array([[2, 2], [3, 3]])), "duplicate"),
+    ]
+    for i, (delta, needle) in enumerate(bad):
+        assert sg.ingest(delta) is False
+        assert needle in sg.quarantined[-1].reason
+        assert sg.quarantined[-1].stage == "ingest"
+    assert not sg.staged
+    assert int(np.asarray(sg.metrics.value(DELTAS_QUARANTINED))) == len(bad)
+    assert topo.version == 0
+
+
+def test_admission_update_validation():
+    topo, _ = _graph()
+    import types
+
+    store = types.SimpleNamespace(shape=(topo.node_count, 4),
+                                  apply_row_updates=lambda ids, rows: None)
+    sg = StreamingGraph(topo, feature=store)
+    nan_rows = np.ones((1, 4), np.float32)
+    nan_rows[0, 2] = np.nan
+    assert not sg.ingest(
+        DeltaBatch(update_ids=np.array([1]), update_rows=nan_rows))
+    assert "non-finite" in sg.quarantined[-1].reason
+    assert not sg.ingest(
+        DeltaBatch(update_ids=np.array([1]),
+                   update_rows=np.ones((1, 3), np.float32)))
+    assert "feature dim" in sg.quarantined[-1].reason
+    assert not sg.ingest(DeltaBatch(update_ids=np.array([1])))
+    assert "together" in sg.quarantined[-1].reason
+    assert not sg.ingest(
+        DeltaBatch(update_ids=np.array([1, 1]),
+                   update_rows=np.ones((2, 4), np.float32)))
+    assert "duplicate update_ids" in sg.quarantined[-1].reason
+
+
+def test_duplicates_allow_policy():
+    topo, _ = _graph()
+    import types
+
+    seen = {}
+    store = types.SimpleNamespace(
+        shape=(topo.node_count, 2),
+        apply_row_updates=lambda ids, rows: seen.update(
+            {"ids": ids.copy(), "rows": rows.copy()}),
+        note_degree_update=lambda deg: None,
+    )
+    sg = StreamingGraph(topo, feature=store, duplicates="allow")
+    # parallel edges admitted; duplicate update ids collapse last-wins
+    rows = np.stack([np.full(2, 1.0, np.float32),
+                     np.full(2, 2.0, np.float32),
+                     np.full(2, 3.0, np.float32)])
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=np.array([[2, 2], [3, 3]]),
+        update_ids=np.array([7, 9, 7]), update_rows=rows))
+    res = sg.commit()
+    assert res.edges_inserted == 2 and res.rows_updated == 2
+    order = np.argsort(seen["ids"])
+    assert np.array_equal(seen["ids"][order], [7, 9])
+    assert np.array_equal(seen["rows"][order][:, 0], [3.0, 2.0])
+
+
+def test_delete_existence_is_multiset_aware():
+    topo, coo = _graph()
+    sg = StreamingGraph(topo)
+    ms, md = _missing_pair(topo, coo)
+    # deleting an edge staged-inserted earlier in the window is legal
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array([[ms], [md]])))
+    assert sg.ingest(DeltaBatch(edge_deletes=np.array([[ms], [md]])))
+    # but a SECOND delete of the same (now spent) pair is not
+    assert not sg.ingest(DeltaBatch(edge_deletes=np.array([[ms], [md]])))
+    assert "live edge" in sg.quarantined[-1].reason
+
+
+# -- commit / rollback -------------------------------------------------------
+
+
+def test_commit_matches_full_rebuild_bitwise():
+    topo, coo = _graph(n=300, e=4000, seed=1)
+    n = topo.node_count
+    rng = np.random.default_rng(7)
+    ins = rng.integers(0, n, size=(2, 57)).astype(np.int64)
+    # delete a sample of live edges (first occurrences)
+    del_pos = rng.choice(coo.shape[1], size=23, replace=False)
+    dele = coo[:, del_pos]
+    sg = StreamingGraph(topo, duplicates="allow")
+    assert sg.ingest(DeltaBatch(edge_inserts=ins, edge_deletes=dele))
+    res = sg.commit()
+    assert res.version == 1
+    assert res.edge_count == coo.shape[1] + 57 - 23
+    # oracle: rebuild from the equivalent final COO — original edges with
+    # the deleted occurrences removed, inserts appended in order
+    n_enc = coo[0] * n + coo[1]
+    remove = np.zeros(coo.shape[1], bool)
+    from collections import Counter
+
+    want = Counter((dele[0] * n + dele[1]).tolist())
+    order = np.argsort(coo[0], kind="stable")  # CSR slot order
+    for pos in order.tolist():
+        k = int(n_enc[pos])
+        if want.get(k, 0) > 0:
+            want[k] -= 1
+            remove[pos] = True
+    final = np.concatenate([coo[:, ~remove], ins], axis=1)
+    oracle = CSRTopo(edge_index=final)
+    assert np.array_equal(np.asarray(topo.indptr, np.int64),
+                          np.asarray(oracle.indptr, np.int64))
+    assert np.array_equal(np.asarray(topo.indices, np.int64),
+                          np.asarray(oracle.indices, np.int64))
+    assert int(np.asarray(sg.metrics.value(DELTAS_COMMITTED))) == 1
+    assert int(np.asarray(sg.metrics.value(STREAMING_COMMITS))) == 1
+
+
+@pytest.mark.parametrize("stage", ["merge", "verify", "features"])
+def test_commit_rollback_on_injected_failure(stage):
+    topo, _ = _graph()
+    src, dst = _first_live_edge(topo)
+    old_ip = np.asarray(topo.indptr).copy()
+    old_ix = np.asarray(topo.indices).copy()
+    sg = StreamingGraph(topo)
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=np.array([[1, 2], [3, 4]]),
+        edge_deletes=np.array([[src], [dst]])))
+    with pytest.raises(CommitAborted, match=stage):
+        sg.commit(inject_failure=stage)
+    # pre-commit state is bit-identical; the batch is quarantined whole
+    assert topo.version == 0
+    assert np.array_equal(old_ip, np.asarray(topo.indptr))
+    assert np.array_equal(old_ix, np.asarray(topo.indices))
+    assert not sg.staged
+    assert sg.quarantined[-1].stage == "commit"
+    assert int(np.asarray(sg.metrics.value(DELTAS_QUARANTINED))) == 1
+
+
+def test_commit_empty_is_noop():
+    topo, _ = _graph()
+    sg = StreamingGraph(topo)
+    assert sg.commit() is None
+    assert topo.version == 0
+
+
+def test_verify_catches_untouched_corruption():
+    topo, _ = _graph(n=50, e=400, seed=3)
+    indptr = np.asarray(topo.indptr, np.int64)
+    indices = np.asarray(topo.indices, np.int64)
+    ins = np.array([[0], [1]])
+    new_ip, new_ix, touched = merge_csr(indptr, indices, ins, None)
+    verify_merged_csr(indptr, indices, new_ip, new_ix, touched, 1, 0)
+    # corrupt a neighbor of an UNTOUCHED row: the checksum must catch it
+    victim = int(np.flatnonzero(~touched & (np.diff(new_ip) > 0))[0])
+    bad = new_ix.copy()
+    pos = int(new_ip[victim])
+    bad[pos] = (bad[pos] + 1) % topo.node_count
+    with pytest.raises(DeltaRejected, match="checksum"):
+        verify_merged_csr(indptr, indices, new_ip, bad, touched, 1, 0)
+
+
+def test_weighted_topology_rejected():
+    topo, coo = _graph()
+    topo.set_edge_weight(np.ones(coo.shape[1]))
+    with pytest.raises(NotImplementedError, match="weighted"):
+        StreamingGraph(topo)
+
+
+# -- versioned invalidation --------------------------------------------------
+
+
+def test_sampler_stale_raise_and_refresh_parity():
+    topo, coo = _graph(n=256, e=2500, seed=5)
+    sampler = GraphSageSampler(topo, [3, 3], seed=3, seed_capacity=32)
+    seeds = np.arange(16)
+    sampler.sample(seeds)
+    sg = StreamingGraph(topo)
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array([[1], [2]])))
+    sg.commit()
+    with pytest.raises(VersionMismatchError, match="refresh_topology"):
+        sampler.sample(seeds)
+    sampler.refresh_topology()
+    out = sampler.sample(seeds)
+    # parity with a FRESH sampler over the rebuilt final graph: same seed
+    # stream position, same draws, bit-identical output
+    final = np.concatenate([coo, np.array([[1], [2]])], axis=1)
+    fresh = GraphSageSampler(CSRTopo(edge_index=final), [3, 3], seed=3,
+                             seed_capacity=32)
+    fresh._call = sampler._call - 1  # align the per-call key fold
+    ref = fresh.sample(seeds)
+    assert np.array_equal(np.asarray(out.n_id), np.asarray(ref.n_id))
+    for a, b in zip(out.adjs, ref.adjs):
+        assert np.array_equal(np.asarray(a.edge_index),
+                              np.asarray(b.edge_index))
+
+
+# -- feature tiers -----------------------------------------------------------
+
+
+def _mesh(data, feature):
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_devices=data * feature, data=data, feature=feature)
+
+
+def _store(topo, feat, mesh, dtype=None, auto_split=False):
+    from quiver_tpu.feature.shard import ShardedFeature
+
+    f = feat.shape[1]
+    return ShardedFeature(
+        mesh, device_cache_size=16 * f * 4, replicate_budget=8 * f * 4,
+        csr_topo=topo, dtype=dtype, auto_split=auto_split,
+    ).from_cpu_tensor(feat)
+
+
+def test_row_updates_serve_fresh_in_every_tier():
+    topo, _ = _graph(n=256, e=3000, seed=6)
+    rng = np.random.default_rng(6)
+    feat = rng.normal(size=(256, 16)).astype(np.float32)
+    store = _store(topo, feat, _mesh(1, 8))
+    assert store.rep_rows > 0 and store.hot_rows > 0 and store.cold is not None
+    order = np.asarray(store.feature_order)
+    inv = np.empty(256, np.int64)
+    inv[order] = np.arange(256)
+    ids = np.array([
+        int(inv[0]),                                # pinned in L0
+        int(inv[store.rep_rows]),                   # first L1 row
+        int(inv[store.rep_rows + store.hot_rows]),  # first cold row
+    ])
+    rows = rng.normal(size=(3, 16)).astype(np.float32) + 50.0
+    sg = StreamingGraph(topo, feature=store)
+    assert sg.ingest(DeltaBatch(update_ids=ids, update_rows=rows))
+    sg.commit()
+    assert store.version == 1
+    assert np.array_equal(np.asarray(store.gather(ids)), rows)
+    # the no-stale-L0 contract: the pinned row serves the NEW value from
+    # every chip's replica
+    for shard in store.rep.addressable_shards:
+        assert np.array_equal(np.asarray(shard.data)[0], rows[0])
+    others = np.setdiff1d(np.arange(256), ids)[:32]
+    assert np.array_equal(np.asarray(store.gather(others)), feat[others])
+
+
+def test_row_updates_quantized_store_requantizes():
+    from quiver_tpu.feature.shard import ShardedFeature
+
+    topo, _ = _graph(n=256, e=3000, seed=8)
+    rng = np.random.default_rng(8)
+    feat = rng.normal(size=(256, 8)).astype(np.float32)
+    # int8 budgets must clear the replicated 4n-byte scale array floor
+    store = ShardedFeature(
+        _mesh(1, 8), device_cache_size=4 * 256 + 16 * 8,
+        replicate_budget=8 * 8, csr_topo=topo, dtype="int8",
+    ).from_cpu_tensor(feat)
+    assert store.rep_rows > 0 and store.hot_rows > 0
+    order = store.feature_order
+    ids = np.array([0, 100])
+    rows = np.array([np.full(8, 3.0), np.full(8, -1.5)], np.float32)
+    store.apply_row_updates(ids, rows)
+    got = np.asarray(store.gather(ids))
+    # int8 storage: values round-trip through per-row absmax quantization
+    assert np.allclose(got, rows, atol=np.abs(rows).max() / 127 + 1e-6)
+    t = np.asarray(order)[ids] if order is not None else ids
+    assert np.allclose(np.asarray(store.scale)[t],
+                       np.abs(rows).max(axis=1) / 127.0)
+
+
+def test_row_update_then_replan_keeps_fresh_values():
+    # the satellite: ShardedFeature.replan + L0 interaction after a row
+    # update — the updated pinned row must serve the new value on every
+    # chip of the NEW mesh too
+    topo, _ = _graph(n=256, e=3000, seed=9)
+    rng = np.random.default_rng(9)
+    feat = rng.normal(size=(256, 16)).astype(np.float32)
+    store = _store(topo, feat, _mesh(1, 8))
+    order = np.asarray(store.feature_order)
+    inv = np.empty(256, np.int64)
+    inv[order] = np.arange(256)
+    pinned = int(inv[0])
+    cold_id = int(inv[store.rep_rows + store.hot_rows])
+    rows = rng.normal(size=(2, 16)).astype(np.float32) + 9.0
+    store.apply_row_updates(np.array([pinned, cold_id]), rows)
+    store.replan(_mesh(1, 4))
+    got = np.asarray(store.gather(np.array([pinned, cold_id])))
+    assert np.array_equal(got, rows)
+    for shard in store.rep.addressable_shards:
+        assert np.array_equal(np.asarray(shard.data)[0], rows[0])
+    assert store.version == 1  # replan is placement, not mutation
+
+
+def test_row_update_rejects_bad_input_bit_identically():
+    topo, _ = _graph(n=128, e=1500, seed=10)
+    rng = np.random.default_rng(10)
+    feat = rng.normal(size=(128, 8)).astype(np.float32)
+    store = _store(topo, feat, _mesh(1, 8))
+    before = np.asarray(store.gather(np.arange(128)))
+    bad_rows = np.ones((1, 8), np.float32)
+    bad_rows[0, 0] = np.inf
+    for ids, rows, match in [
+        (np.array([1]), bad_rows, "non-finite"),
+        (np.array([200]), np.ones((1, 8), np.float32), "in \\[0, 128\\)"),
+        (np.array([1, 1]), np.ones((2, 8), np.float32), "duplicate"),
+        (np.array([1]), np.ones((1, 5), np.float32), "feature dim"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            store.apply_row_updates(ids, rows)
+    assert store.version == 0
+    assert np.array_equal(np.asarray(store.gather(np.arange(128))), before)
+
+
+def test_degree_update_feeds_split_tuner():
+    topo, _ = _graph(n=256, e=3000, seed=11)
+    rng = np.random.default_rng(11)
+    feat = rng.normal(size=(256, 8)).astype(np.float32)
+    store = _store(topo, feat, _mesh(1, 8), auto_split=True)
+    rep0 = store.rep_rows
+    assert rep0 > 0
+    # post-mutation degrees concentrate ALL heat outside L0: the tuner's
+    # existing shrink rule must hand the replicated rows back
+    order = np.asarray(store.feature_order)
+    inv = np.empty(256, np.int64)
+    inv[order] = np.arange(256)
+    deg = np.zeros(256, np.int64)
+    deg[inv[rep0: rep0 + store.hot_rows]] = 100
+    store.note_degree_update(deg)
+    assert store.rep_rows == rep0 // 2
+
+
+def test_trainer_stale_raise_and_refresh():
+    import optax
+
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    topo, _ = _graph(n=128, e=1200, seed=12)
+    rng = np.random.default_rng(12)
+    feat = rng.normal(size=(128, 4)).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 3, 128).astype(np.int32))
+    mesh = _mesh(2, 4)
+    store = _store(topo, feat, mesh)
+    sampler = GraphSageSampler(topo, [2, 2], seed=3, seed_capacity=8,
+                               topo_sharding="mesh", mesh=mesh)
+    tr = DistributedTrainer(
+        mesh, sampler, store, GraphSAGE(hidden=4, num_classes=3,
+                                        num_layers=2),
+        optax.sgd(1e-2), local_batch=8, seed_sharding="all",
+    )
+    params, opt = tr.init(jax.random.PRNGKey(0))
+    idx = rng.integers(0, 128, tr.global_batch)
+    params, opt, _ = tr.step(params, opt, idx, labels, jax.random.PRNGKey(1))
+    sg = StreamingGraph(topo, feature=store)
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=np.array([[1], [2]]),
+        update_ids=np.array([5]),
+        update_rows=np.full((1, 4), 2.5, np.float32)))
+    sg.commit()
+    with pytest.raises(VersionMismatchError, match="refresh"):
+        tr.step(params, opt, idx, labels, jax.random.PRNGKey(2))
+    with pytest.raises(VersionMismatchError, match="refresh"):
+        tr.epoch_scan(params, opt, tr.pack_epoch(idx, seed=0), labels,
+                      jax.random.PRNGKey(2))
+    tr.refresh()
+    params, opt, loss = tr.step(params, opt, idx, labels,
+                                jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
+
+
+# -- CSRTopo hardening satellites --------------------------------------------
+
+
+def test_save_is_atomic(tmp_path, monkeypatch):
+    topo, _ = _graph(n=64, e=400, seed=13)
+    path = str(tmp_path / "topo.npz")
+    topo.save(path)
+    good = open(path, "rb").read()
+    # a crash mid-save (np.savez dies) must leave the published file
+    # intact and no temp litter
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", boom)
+    with pytest.raises(OSError, match="disk full"):
+        topo.save(path)
+    monkeypatch.undo()
+    assert open(path, "rb").read() == good
+    assert os.listdir(tmp_path) == ["topo.npz"]
+    back = CSRTopo.load(path)
+    assert np.array_equal(np.asarray(back.indptr), np.asarray(topo.indptr))
+
+
+def test_load_truncated_raises_clearly(tmp_path):
+    topo, _ = _graph(n=64, e=400, seed=14)
+    path = str(tmp_path / "topo.npz")
+    topo.save(path)
+    blob = open(path, "rb").read()
+    trunc = str(tmp_path / "trunc.npz")
+    with open(trunc, "wb") as fh:
+        fh.write(blob[: len(blob) // 3])
+    with pytest.raises(ValueError, match="truncated|corrupt|readable"):
+        CSRTopo.load(trunc)
+    junk = str(tmp_path / "junk.npz")
+    with open(junk, "wb") as fh:
+        fh.write(b"not a zip at all")
+    with pytest.raises(ValueError, match="truncated|corrupt|readable"):
+        CSRTopo.load(junk)
+    # a real .npz that is not a topology artifact names what's missing
+    partial = str(tmp_path / "partial.npz")
+    np.savez(partial, indptr=np.asarray(topo.indptr))
+    with pytest.raises(ValueError, match="indices"):
+        CSRTopo.load(partial)
+
+
+def test_ctor_rejects_negative_indices():
+    with pytest.raises(ValueError, match="negative"):
+        CSRTopo(indptr=np.array([0, 2]), indices=np.array([0, -1]))
+
+
+# -- slow differentials ------------------------------------------------------
+
+
+def _build_diff_trainer(topo, feat_arr, mesh, local_batch):
+    import optax
+
+    from quiver_tpu.feature.shard import ShardedFeature
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    f = feat_arr.shape[1]
+    # csr_topo=None: no degree reorder, so the incremental store and the
+    # rebuilt store share one (identity) row order — the differential
+    # compares graph content, not placement policy
+    store = ShardedFeature(
+        mesh, device_cache_size=24 * f * 4, replicate_budget=8 * f * 4,
+    ).from_cpu_tensor(feat_arr)
+    sampler = GraphSageSampler(topo, [3, 3], seed=3,
+                               seed_capacity=local_batch,
+                               topo_sharding="mesh", mesh=mesh)
+    tr = DistributedTrainer(
+        mesh, sampler, store, GraphSAGE(hidden=8, num_classes=4,
+                                        num_layers=2),
+        optax.sgd(1e-2), local_batch=local_batch, seed_sharding="all",
+    )
+    return tr, store
+
+
+@pytest.mark.slow
+def test_epoch_differential_incremental_vs_rebuild():
+    """Train with deltas committed at the epoch boundary vs a full
+    rebuild from the equivalent final graph: same sampled batches and
+    loss trajectory, bitwise (same seed)."""
+    n, f, lb = 384, 8, 16
+    rng = np.random.default_rng(42)
+    coo = rng.integers(0, n, size=(2, 4000)).astype(np.int64)
+    feat0 = rng.normal(size=(n, f)).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+    mesh = _mesh(2, 4)
+
+    ins = rng.integers(0, n, size=(2, 64)).astype(np.int64)
+    del_pos = rng.choice(coo.shape[1], size=40, replace=False)
+    upd_ids = rng.choice(n, size=24, replace=False)
+    upd_rows = rng.normal(size=(24, f)).astype(np.float32)
+
+    # ---- incremental path: epoch 0 on G0, commit at the boundary,
+    # refresh, epoch 1 on the mutated resident state ----
+    topo_inc = CSRTopo(edge_index=coo)
+    tr_inc, store_inc = _build_diff_trainer(topo_inc, feat0, mesh, lb)
+    params, opt = tr_inc.init(jax.random.PRNGKey(0))
+    idx = rng.integers(0, n, 4 * tr_inc.global_batch)
+    seed_mat = tr_inc.pack_epoch(idx, seed=0)
+    params, opt, losses0 = tr_inc.epoch_scan(
+        params, opt, seed_mat, labels, jax.random.PRNGKey(7))
+    sg = StreamingGraph(topo_inc, feature=store_inc, duplicates="allow")
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=ins, edge_deletes=coo[:, del_pos],
+        update_ids=upd_ids, update_rows=upd_rows))
+    res = sg.commit()
+    assert res.version == 1
+    tr_inc.refresh()
+    p1, o1, losses1_inc = tr_inc.epoch_scan(
+        params, opt, seed_mat, labels, jax.random.PRNGKey(21))
+
+    # ---- rebuild path: the equivalent final graph from scratch, fed the
+    # SAME post-epoch-0 state, seed matrix, and key ----
+    n_enc = coo[0] * n + coo[1]
+    from collections import Counter
+
+    want = Counter((coo[0, del_pos] * n + coo[1, del_pos]).tolist())
+    remove = np.zeros(coo.shape[1], bool)
+    for pos in np.argsort(coo[0], kind="stable").tolist():
+        k = int(n_enc[pos])
+        if want.get(k, 0) > 0:
+            want[k] -= 1
+            remove[pos] = True
+    final_coo = np.concatenate([coo[:, ~remove], ins], axis=1)
+    feat_final = feat0.copy()
+    feat_final[upd_ids] = upd_rows
+    topo_reb = CSRTopo(edge_index=final_coo)
+    assert np.array_equal(np.asarray(topo_inc.indptr, np.int64),
+                          np.asarray(topo_reb.indptr, np.int64))
+    assert np.array_equal(np.asarray(topo_inc.indices, np.int64),
+                          np.asarray(topo_reb.indices, np.int64))
+    tr_reb, store_reb = _build_diff_trainer(topo_reb, feat_final, mesh, lb)
+    p1r, o1r, losses1_reb = tr_reb.epoch_scan(
+        params, opt, seed_mat, labels, jax.random.PRNGKey(21))
+
+    # the epoch-1 SAMPLED BATCHES are bit-identical: same sampler seed
+    # stream over byte-identical CSR partitions
+    s_inc, s_reb = tr_inc.sampler, tr_reb.sampler
+    key = jax.random.PRNGKey(33)
+    out_i = s_inc.sample(idx[: lb * s_inc.workers], key=key)
+    out_r = s_reb.sample(idx[: lb * s_reb.workers], key=key)
+    assert np.array_equal(np.asarray(out_i.n_id), np.asarray(out_r.n_id))
+    for a, b in zip(out_i.adjs, out_r.adjs):
+        assert np.array_equal(np.asarray(a.edge_index),
+                              np.asarray(b.edge_index))
+    # and the loss trajectory + final params match bitwise
+    assert np.array_equal(
+        np.asarray(losses1_inc).view(np.uint32),
+        np.asarray(losses1_reb).view(np.uint32))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p1r)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_mid_commit_crash_training_continues_unperturbed():
+    """A commit that dies before publish must leave the run EXACTLY as if
+    the commit were never attempted: epoch 1 proceeds on the old version
+    with a bit-identical trajectory."""
+    n, f, lb = 256, 8, 16
+    rng = np.random.default_rng(43)
+    coo = rng.integers(0, n, size=(2, 3000)).astype(np.int64)
+    feat0 = rng.normal(size=(n, f)).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+    mesh = _mesh(2, 4)
+
+    def run(crash: bool):
+        topo = CSRTopo(edge_index=coo)
+        tr, store = _build_diff_trainer(topo, feat0, mesh, lb)
+        params, opt = tr.init(jax.random.PRNGKey(0))
+        idx = np.random.default_rng(5).integers(0, n, 3 * tr.global_batch)
+        seed_mat = tr.pack_epoch(idx, seed=0)
+        params, opt, _ = tr.epoch_scan(
+            params, opt, seed_mat, labels, jax.random.PRNGKey(7))
+        if crash:
+            sg = StreamingGraph(topo, feature=store)
+            assert sg.ingest(DeltaBatch(
+                edge_inserts=np.array([[1, 2], [3, 4]])))
+            with pytest.raises(CommitAborted):
+                sg.commit(inject_failure="verify")
+            assert topo.version == 0 and store.version == 0
+        # NO refresh needed — nothing was published
+        params, opt, losses = tr.epoch_scan(
+            params, opt, seed_mat, labels, jax.random.PRNGKey(21))
+        return np.asarray(losses), params
+
+    losses_a, params_a = run(crash=False)
+    losses_b, params_b = run(crash=True)
+    assert np.array_equal(losses_a.view(np.uint32),
+                          losses_b.view(np.uint32))
+    for a, b in zip(jax.tree_util.tree_leaves(params_a),
+                    jax.tree_util.tree_leaves(params_b)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
