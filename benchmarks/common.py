@@ -658,6 +658,8 @@ def build_graph(args):
                 arrays["eid"] = topo.eid
             with open(tmp, "wb") as fh:
                 np.savez(fh, **arrays)
+                fh.flush()
+                os.fsync(fh.fileno())
             os.replace(tmp, cache)
         except Exception as e:  # noqa: BLE001
             log(f"graph cache save failed ({e}); continuing uncached")

@@ -17,21 +17,49 @@ from bugs this repo actually shipped and fixed:
   one-shot ``info_once`` idiom.
 * ``export-doc-drift`` — ``__all__`` exports missing from ``docs/API.md``.
 
+graftlint v2 adds an interprocedural dataflow engine — per-function CFGs
+with dominator computation (``tools/lint/cfg.py``), so rules require that
+an operation is *dominated* by a guard, with guard facts propagated
+across the call graph — and three rule families distilled from the
+PR 5-8 disciplines:
+
+* **staleness** — ``stale-version-read``: public methods of a
+  version-guarded class reading re-captured state without a dominating
+  ``VersionMismatchError`` guard (the PR 8 discipline).
+* **transaction** — ``non-atomic-publish`` / ``commit-marker-order`` /
+  ``replace-without-fsync``: the temp-dir + fsync + ``os.replace`` +
+  COMMIT-last save discipline (PR 7) machine-checked.
+* **concurrency** — ``executor-lifecycle`` / ``lock-held-across-call`` /
+  ``metric-name-constant``: executors need a reachable shutdown path,
+  non-reentrant locks must not be held across re-entering calls, and
+  registry metric names must use the ``obs/registry.py`` constants.
+
 CLI: ``python -m quiver_tpu.tools.lint [paths]`` (``--json``,
-``--list-rules``, ``--select``, ``--ignore``; exit 0 clean / 1 findings /
-2 usage). Inline suppression: ``# graftlint: disable=<rule> -- <reason>``
-— the reason is mandatory.
+``--list-rules``, ``--select``/``--ignore`` accepting rules or families,
+``--changed BASE`` for O(diff) reporting, ``--sarif PATH`` for CI
+annotation, ``--debt`` for the reasoned-suppression report; exit 0 clean
+/ 1 findings / 2 usage). Inline suppression: ``# graftlint:
+disable=<rule> -- <reason>`` — the reason is mandatory.
 """
 
-from .rules import Finding, RULES, rule_docs
-from .runner import LintResult, collect_files, lint_paths
+from .rules import FAMILIES, Finding, RULES, family_of, rule_docs
+from .runner import LintResult, changed_files, collect_files, lint_paths
+from .report import build_debt, build_sarif
+from .cfg import CFG, build_cfg
 from .cli import main
 
 __all__ = [
+    "CFG",
+    "FAMILIES",
     "Finding",
     "LintResult",
     "RULES",
+    "build_cfg",
+    "build_debt",
+    "build_sarif",
+    "changed_files",
     "collect_files",
+    "family_of",
     "lint_paths",
     "main",
     "rule_docs",

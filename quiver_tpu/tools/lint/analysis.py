@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 
 __all__ = [
     "FuncInfo",
@@ -92,6 +93,11 @@ _BUILTIN_METHOD_NAMES = frozenset(
 _HOST_CALLBACK_WRAPPERS = {"callback", "io_callback", "pure_callback",
                            "debug_callback"}
 
+# a registry metric name: dotted lowercase segments, each starting with a
+# letter ("feature.routed_overflow") — version strings like "1.0" do not
+# match
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
 
 def terminal_name(expr: ast.AST) -> str | None:
     """The rightmost name of a call target: ``jax.lax.psum`` -> ``psum``."""
@@ -105,7 +111,12 @@ def terminal_name(expr: ast.AST) -> str | None:
 def iter_owned(func_node: ast.AST):
     """Yield the AST nodes lexically owned by one function — its body minus
     the bodies of nested function/class definitions (those have their own
-    FuncInfo / are analyzed separately)."""
+    FuncInfo / are analyzed separately). Nested defs themselves are
+    yielded (the ``def`` executes in this scope) but never descended
+    into — including when they sit directly in the body (a module's
+    top-level functions must not leak their statements into the module
+    pseudo-function)."""
+    defs = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
     if isinstance(func_node, ast.Lambda):
         roots = [func_node.body]
     else:
@@ -114,9 +125,11 @@ def iter_owned(func_node: ast.AST):
     while stack:
         node = stack.pop()
         yield node
+        if isinstance(node, defs):
+            continue
         for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda, ast.ClassDef)):
+            if isinstance(child, defs):
+                stack.append(child)  # yield the def, not its body
                 continue
             stack.append(child)
 
@@ -204,7 +217,13 @@ class Project:
         default_factory=dict)
     declared_axes: dict[str, str] = dataclasses.field(
         default_factory=dict)  # constant name -> axis string
+    # metric-name constants (obs/registry.py discipline): ALL_CAPS module
+    # constants whose value is a dotted lowercase metric name
+    declared_metrics: dict[str, str] = dataclasses.field(
+        default_factory=dict)
     node_func: dict[int, FuncInfo] = dataclasses.field(default_factory=dict)
+    # id(func node) -> CFG, filled lazily by tools.lint.cfg.cfg_of
+    cfg_cache: dict = dataclasses.field(default_factory=dict)
 
     def owner_of(self, node: ast.AST) -> FuncInfo | None:
         return self.node_func.get(id(node))
@@ -430,13 +449,19 @@ class _Collector(ast.NodeVisitor):
         for t in node.targets:
             self._bind_target(t)
             self.visit(t)
-        # module-level axis-name constants: NAME_AXIS = "literal"
+        # module-level axis-name constants: NAME_AXIS = "literal"; and
+        # metric-name constants: ALL_CAPS = "dotted.lowercase"
         if (self.stack[-1].is_module
                 and isinstance(node.value, ast.Constant)
                 and isinstance(node.value.value, str)):
             for t in node.targets:
-                if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id.endswith("_AXIS"):
                     self.project.declared_axes[t.id] = node.value.value
+                elif (t.id.isupper()
+                      and _METRIC_NAME_RE.match(node.value.value)):
+                    self.project.declared_metrics[t.id] = node.value.value
 
     def visit_AnnAssign(self, node):
         self._own(node)

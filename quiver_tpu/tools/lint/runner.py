@@ -21,17 +21,20 @@ import re
 import tokenize
 
 from .analysis import SourceFile, analyze
-from .rules import Finding, META_RULES, RULES
+from .rules import FAMILIES, Finding, META_RULES, RULES
 
-__all__ = ["LintResult", "lint_paths", "collect_files"]
+__all__ = ["LintResult", "changed_files", "collect_files", "lint_paths"]
 
+# anchored at the comment START: a directive is the comment's whole job —
+# prose that merely QUOTES the syntax mid-comment (the lint tool's own
+# sources do) is not an annotation and must not land in the --debt report
 _SUPPRESS_RE = re.compile(
-    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"^#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+?)"
     r"(?:\s*--\s*(.*?))?\s*$"
 )
 # function-level trace barrier: on (or directly above) a def line, declares
 # the function eager-only by contract; reason mandatory like suppressions
-_EAGER_RE = re.compile(r"#\s*graftlint:\s*eager(?:\s*--\s*(.*?))?\s*$")
+_EAGER_RE = re.compile(r"^#\s*graftlint:\s*eager(?:\s*--\s*(.*?))?\s*$")
 
 
 @dataclasses.dataclass
@@ -43,10 +46,29 @@ class Suppression:
 
 
 @dataclasses.dataclass
+class Annotation:
+    """One graftlint source annotation (suppression or eager pin) — the
+    unit of the ``--debt`` report: every one is reasoned by construction
+    (reasonless annotations are bad-suppression findings instead)."""
+
+    kind: str  # "disable" | "eager"
+    path: str
+    line: int
+    rules: tuple[str, ...]  # ("eager",) for pins
+    reason: str
+
+    def to_dict(self):
+        return {"kind": self.kind, "path": self.path, "line": self.line,
+                "rules": list(self.rules), "reason": self.reason}
+
+
+@dataclasses.dataclass
 class LintResult:
     findings: list[Finding]  # active (unsuppressed), sorted
     suppressed: list[Finding]
     files: list[str]
+    # every reasoned annotation in the analyzed set (suppression debt)
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
 
     @property
     def exit_code(self) -> int:
@@ -54,11 +76,12 @@ class LintResult:
 
     def to_dict(self):
         return {
-            "version": 1,
+            "version": 2,
             "files_analyzed": len(self.files),
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
             "counts": _counts(self.findings),
+            "annotations": [a.to_dict() for a in self.annotations],
         }
 
 
@@ -132,9 +155,9 @@ def _parse_suppressions(path: str, text: str):
             continue
         m = _SUPPRESS_RE.search(comment)
         if not m:
-            # only directive-looking comments (marker followed by a colon)
-            # are checked; prose that merely mentions graftlint is fine
-            if "graftlint" + ":" in comment:
+            # only comments that START with the marker are directives;
+            # prose that merely mentions/quotes graftlint syntax is fine
+            if re.match(r"^#\s*graftlint\s*:", comment):
                 bad.append(Finding(
                     "bad-suppression", path, lineno, col,
                     "malformed graftlint comment; expected '# graftlint: "
@@ -163,13 +186,66 @@ def _parse_suppressions(path: str, text: str):
     return sups, eager, bad
 
 
-def lint_paths(paths, select=None, ignore=None) -> LintResult:
+def _expand_rule_tokens(tokens) -> set[str]:
+    """Resolve a select/ignore token list: family names expand to their
+    member rules; unknown tokens raise."""
+    out: set[str] = set()
+    unknown = []
+    for tok in tokens:
+        if tok in FAMILIES:
+            out.update(FAMILIES[tok])
+        elif tok in RULES:
+            out.add(tok)
+        else:
+            unknown.append(tok)
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s)/famil(ies): {sorted(unknown)} "
+            f"(rules: {sorted(RULES)}; families: {sorted(FAMILIES)})")
+    return out
+
+
+def changed_files(base: str, cwd: str | None = None) -> set[str]:
+    """Absolute paths of .py files changed vs ``base`` per ``git diff
+    --name-only`` (committed + staged + worktree changes). Raises
+    ValueError when git cannot answer (not a repo, unknown base)."""
+    import subprocess
+
+    cwd = cwd or os.getcwd()
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            cwd=cwd, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise ValueError(f"--changed: cannot run git: {e}") from e
+    if proc.returncode != 0:
+        raise ValueError(
+            f"--changed: git diff --name-only {base} failed: "
+            f"{proc.stderr.strip()}")
+    root = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        cwd=cwd, capture_output=True, text=True, timeout=30,
+    ).stdout.strip() or cwd
+    return {
+        os.path.abspath(os.path.join(root, line.strip()))
+        for line in proc.stdout.splitlines()
+        if line.strip().endswith(".py")
+    }
+
+
+def lint_paths(paths, select=None, ignore=None, only=None) -> LintResult:
     """Run graftlint over files/directories. ``select``/``ignore`` are
-    iterables of rule names (select wins; both default to all rules)."""
+    iterables of rule names OR family names (select wins; both default to
+    all rules). ``only`` (a set of absolute file paths — the --changed
+    mode) restricts which files findings are REPORTED for; the analysis
+    itself always runs over the full file set so cross-file facts (axis
+    constants, the call graph, guard propagation) stay sound."""
     file_paths = collect_files(paths)
     sources: list[SourceFile] = []
     findings: list[Finding] = []
     suppressions: dict[str, list[Suppression]] = {}
+    annotations: list[Annotation] = []
     for path in file_paths:
         display = _display_path(path)
         try:
@@ -187,21 +263,22 @@ def lint_paths(paths, select=None, ignore=None) -> LintResult:
                                   eager_lines=eager))
         suppressions[display] = sups
         findings.extend(bad)
+        for s in sups:
+            annotations.append(Annotation(
+                "disable", display, s.line, s.rules, s.reason))
+        for line, reason in sorted(eager.items()):
+            annotations.append(Annotation(
+                "eager", display, line, ("eager",), reason))
 
     project = analyze(sources)
     active_rules = dict(RULES)
     if select:
-        wanted = set(select)
-        unknown = wanted - set(RULES)
-        if unknown:
-            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        wanted = _expand_rule_tokens(select)
         active_rules = {k: v for k, v in RULES.items() if k in wanted}
     if ignore:
-        unknown = set(ignore) - set(RULES)
-        if unknown:
-            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        dropped = _expand_rule_tokens(ignore)
         active_rules = {k: v for k, v in active_rules.items()
-                        if k not in set(ignore)}
+                        if k not in dropped}
     for check in active_rules.values():
         findings.extend(check(project))
 
@@ -219,7 +296,19 @@ def lint_paths(paths, select=None, ignore=None) -> LintResult:
             suppressed.append(f)
         else:
             active.append(f)
+    if only is not None:
+        keep = {os.path.abspath(p) for p in only}
+
+        def kept(f: Finding) -> bool:
+            return os.path.abspath(f.path) in keep
+
+        active = [f for f in active if kept(f)]
+        suppressed = [f for f in suppressed if kept(f)]
+        annotations = [a for a in annotations
+                       if os.path.abspath(a.path) in keep]
     active.sort(key=Finding.sort_key)
     suppressed.sort(key=Finding.sort_key)
+    annotations.sort(key=lambda a: (a.path, a.line))
     return LintResult(findings=active, suppressed=suppressed,
-                      files=[s.path for s in sources])
+                      files=[s.path for s in sources],
+                      annotations=annotations)

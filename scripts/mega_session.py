@@ -229,6 +229,14 @@ def main():
                 argv = ["--dataset", "planted:5000", "--epochs", "1"]
             elif module.startswith("benchmarks"):
                 argv = list(argv) + ["--smoke"]
+        if key == "lint":
+            # machine-readable evidence next to the scoreboard outputs:
+            # SARIF findings for CI-style annotation plus the reasoned-
+            # suppression debt table in the session log (rule, file,
+            # reason, commit age)
+            argv = list(argv) + [
+                "--sarif", os.path.join(args.out, "lint.sarif"), "--debt",
+            ]
         todo.append((key, module, argv, budget))
     if not todo:
         mark("ALL DONE (nothing left to run)")

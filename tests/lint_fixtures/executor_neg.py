@@ -1,0 +1,47 @@
+"""Negative fixture: every executor has a shutdown path.
+
+Class pools reachable from close()/shutdown() (directly or through a
+private helper), a generator's try/finally shutdown (the Prefetcher
+shape), a with-block, and an explicit ownership transfer.
+"""
+
+import concurrent.futures
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Owned:
+    def __init__(self):
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+
+
+class Indirect:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def shutdown(self):
+        self._stop()
+
+    def _stop(self):
+        self._pool.shutdown(wait=False)
+
+
+def stream(items):
+    pool = ThreadPoolExecutor(max_workers=1)
+    try:
+        for it in items:
+            yield pool.submit(it)
+    finally:
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def scoped(items):
+    with concurrent.futures.ThreadPoolExecutor() as pool:
+        return list(pool.map(str, items))
+
+
+def make_pool():
+    pool = ThreadPoolExecutor(max_workers=1)
+    return pool

@@ -1,0 +1,21 @@
+"""Positive fixture: executors without a shutdown path.
+
+A class-owned pool with no lifecycle method, and a function-local pool
+that is never shut down (submitting futures out of it is use, not
+ownership transfer).
+"""
+
+import concurrent.futures
+
+
+class Leaky:
+    def __init__(self):
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    def submit(self, fn):
+        return self._pool.submit(fn)
+
+
+def run_batch(items):
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+    return [pool.submit(it) for it in items]

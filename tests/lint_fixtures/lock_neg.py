@@ -1,0 +1,39 @@
+"""Negative fixture: reentrancy-safe lock usage.
+
+The ``_locked`` split keeps the lock acquisition at the public boundary;
+the RLock-backed class is exempt (reentrancy is an RLock's point).
+"""
+
+import threading
+
+
+class Safe:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._put_locked(key, value)
+
+    def flush(self):
+        with self._lock:
+            self._rows.clear()
+
+    def _put_locked(self, key, value):
+        self._rows[key] = value
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rows = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._rows[key] = value
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            self._rows.clear()

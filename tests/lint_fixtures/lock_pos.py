@@ -1,0 +1,31 @@
+"""Positive fixture: a non-reentrant lock held across a re-entering call.
+
+``put`` calls ``flush`` while holding ``self._lock`` and ``flush`` takes
+the same lock — ``threading.Lock`` is not reentrant, so this deadlocks
+the owner thread. ``drain`` hits the same bug one call deeper (the
+acquisition fact propagates through same-class calls).
+"""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._rows[key] = value
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            self._rows.clear()
+
+    def drain(self):
+        with self._lock:
+            self.helper()
+
+    def helper(self):
+        self.flush()

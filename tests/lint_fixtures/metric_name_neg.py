@@ -1,0 +1,14 @@
+"""Negative fixture: metric names through the declared constants.
+
+Timeline stage names are NOT registry metrics (different namespace), and
+non-literal name arguments are out of a static linter's reach.
+"""
+
+ROUTED_OVERFLOW = "feature.routed_overflow"
+
+
+def report(registry, tape, timeline, x, name):
+    tape.add(ROUTED_OVERFLOW, x, psum="data")
+    timeline.observe("prefetch.dispatch", 0.1)
+    registry.set(name, x)
+    return registry.value(ROUTED_OVERFLOW)

@@ -1,0 +1,14 @@
+"""Positive fixture: registry metric names bypassing the constants.
+
+One literal that duplicates a declared constant's value (spelling drift
+waiting to happen), one dotted literal matching NO declared constant
+(drift that already happened — note the missing 'o').
+"""
+
+ROUTED_OVERFLOW = "feature.routed_overflow"
+
+
+def report(registry, tape, x):
+    tape.add("feature.routed_overflow", x)
+    registry.counter("feature.routed_overflw")
+    return registry.value(ROUTED_OVERFLOW)

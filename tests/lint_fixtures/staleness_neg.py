@@ -1,0 +1,42 @@
+"""Negative fixture: the PR-8 version-guard pattern, honored.
+
+Same class shape as ``staleness_pos.py``; every public read of the
+placed state is dominated by the version guard — directly, via a callee
+that establishes the guard on every exit (interprocedural propagation),
+or via the rebind seam itself (a dominating ``refresh()`` makes the
+state fresh by construction).
+"""
+
+
+class VersionMismatchError(RuntimeError):
+    pass
+
+
+class PlacedFeature:
+    def __init__(self, host):
+        self.host = host
+        self._rows = dict(host.rows)
+        self._host_version = int(host.version)
+
+    def check_version(self):
+        if int(self.host.version) != self._host_version:
+            raise VersionMismatchError("placement is stale; refresh()")
+
+    def refresh(self):
+        self._rows = dict(self.host.rows)
+        self._host_version = int(self.host.version)
+
+    def _ensure_fresh(self):
+        self.check_version()
+
+    def lookup(self, idx):
+        self.check_version()
+        return self._rows[idx]
+
+    def lookup_via_callee(self, idx):
+        self._ensure_fresh()
+        return self._rows[idx]
+
+    def lookup_after_refresh(self, idx):
+        self.refresh()
+        return self._rows[idx]

@@ -1,0 +1,42 @@
+"""Positive fixture: the PR-8 version-guard pattern, violated.
+
+Miniature of the ``quiver_tpu.streaming`` consumer discipline: a device
+placement captures the host state's committed ``version`` at build time;
+every public read of the placed state must be dominated by the guard that
+raises ``VersionMismatchError`` when the host has committed a newer
+version. v1 graftlint (call-graph reachability only) cannot see either
+violation below — ``lookup`` DOES call the guard (in one branch), and
+``lookup_late`` calls it too (after the read). Only dominance catches
+them.
+"""
+
+
+class VersionMismatchError(RuntimeError):
+    pass
+
+
+class PlacedFeature:
+    def __init__(self, host):
+        self.host = host
+        self._rows = dict(host.rows)
+        self._host_version = int(host.version)
+
+    def check_version(self):
+        if int(self.host.version) != self._host_version:
+            raise VersionMismatchError("placement is stale; refresh()")
+
+    def refresh(self):
+        self._rows = dict(self.host.rows)
+        self._host_version = int(self.host.version)
+
+    def lookup(self, idx):
+        # BUG: the guard runs in one branch only — idx == 0 reads stale
+        if idx > 0:
+            self.check_version()
+        return self._rows[idx]
+
+    def lookup_late(self, idx):
+        # BUG: the guard runs after the read — theater, not protection
+        row = self._rows[idx]
+        self.check_version()
+        return row

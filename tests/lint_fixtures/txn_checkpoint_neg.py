@@ -1,0 +1,30 @@
+"""Negative fixture: the PR-7 atomic save discipline, honored.
+
+Temp-dir writes through a fsyncing helper, COMMIT marker last, one
+``os.replace`` publish; append-mode ledger streams are a different idiom
+and exempt.
+"""
+
+import os
+
+
+def _write_file(path, data):
+    # write helper: the bare-parameter target moves the obligation to the
+    # call sites (all of which pass temp-derived paths below)
+    with open(path, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def save_atomic(out, payload):
+    tmp = f"{out}.tmp-{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    _write_file(os.path.join(tmp, "arrays.bin"), payload)
+    _write_file(os.path.join(tmp, "COMMIT"), b"COMMIT\n")
+    os.replace(tmp, out)
+
+
+def append_ledger(path, line):
+    with open(path, "a") as fh:
+        fh.write(line)

@@ -1,0 +1,37 @@
+"""Positive fixture: the pre-PR-7 save shapes, one per transaction rule.
+
+The module name contains "checkpoint" so the transactional scope applies
+(it also calls ``os.replace``, the self-declaring scope trigger).
+"""
+
+import os
+
+
+def save_bare(state_dir, payload):
+    # non-atomic-publish: direct write to the published path — a crash
+    # mid-write leaves a torn file the next reader trusts
+    path = os.path.join(state_dir, "arrays.bin")
+    with open(path, "wb") as fh:
+        fh.write(payload)
+
+
+def save_marker_first(out, payload):
+    # commit-marker-order: the COMMIT marker lands before the payload
+    tmp = out + ".tmp-fixture"
+    os.makedirs(tmp, exist_ok=True)
+    with open(os.path.join(tmp, "COMMIT"), "w") as fh:
+        fh.write("COMMIT\n")
+    with open(os.path.join(tmp, "arrays.bin"), "wb") as fh:
+        fh.write(payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, out)
+
+
+def publish_unsynced(out, payload):
+    # replace-without-fsync: atomic in the namespace, torn in the page
+    # cache — a crash can surface a zero-length file at the FINAL name
+    tmp = out + ".tmp-fixture2"
+    with open(tmp, "wb") as fh:
+        fh.write(payload)
+    os.replace(tmp, out)

@@ -207,7 +207,7 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     clean.write_text("x = 1\n")
     assert main([str(clean), "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["version"] == 1 and out["findings"] == []
+    assert out["version"] == 2 and out["findings"] == []
 
     assert main([fx("host_op_pos.py"), "--json"]) == 1
     out = json.loads(capsys.readouterr().out)

@@ -1,0 +1,419 @@
+"""graftlint v2 self-tests: the CFG/dominator engine, the interprocedural
+guard propagation, the staleness/transaction/concurrency rule families,
+and the v2 CLI surface (--select families, --changed, --sarif, --debt).
+
+Everything here is pure-ast on tiny sources/fixtures — the whole module
+runs in about a second and lives in the fast lane.
+"""
+
+import ast
+import json
+import os
+import shutil
+import subprocess
+import textwrap
+
+import pytest
+
+from quiver_tpu.tools.lint import FAMILIES, RULES, lint_paths, main
+from quiver_tpu.tools.lint.analysis import SourceFile, analyze
+from quiver_tpu.tools.lint.cfg import (
+    build_cfg,
+    propagate_guard_establishers,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+
+
+def fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def rules_hit(result):
+    return {f.rule for f in result.findings}
+
+
+def _cfg_and_node(src: str, call_name: str):
+    """Build the CFG of the first function in ``src`` and return it with
+    the first call to ``call_name`` in that function."""
+    tree = ast.parse(textwrap.dedent(src))
+    func = tree.body[0]
+    node = next(
+        n for n in ast.walk(func)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id == call_name
+    )
+    return build_cfg(func), node
+
+
+# -- dominance engine --------------------------------------------------------
+
+def test_guard_before_read_dominates():
+    cfg, read = _cfg_and_node(
+        """
+        def f(x):
+            guard()
+            return read(x)
+        """, "read")
+    assert "guard" in cfg.calls_dominating(read)
+
+
+def test_guard_in_one_branch_does_not_dominate():
+    cfg, read = _cfg_and_node(
+        """
+        def f(x):
+            if x:
+                guard()
+            return read(x)
+        """, "read")
+    assert "guard" not in cfg.calls_dominating(read)
+
+
+def test_guard_after_read_does_not_dominate():
+    cfg, read = _cfg_and_node(
+        """
+        def f(x):
+            v = read(x)
+            guard()
+            return v
+        """, "read")
+    assert "guard" not in cfg.calls_dominating(read)
+
+
+def test_guard_inside_loop_does_not_dominate_after():
+    # the loop body may run zero times
+    cfg, read = _cfg_and_node(
+        """
+        def f(xs):
+            for x in xs:
+                guard()
+            return read(xs)
+        """, "read")
+    assert "guard" not in cfg.calls_dominating(read)
+
+
+def test_guard_in_try_body_does_not_dominate_after_handler():
+    # the handler path reaches the read without running the guard's
+    # successor statements; the guard ITSELF (first try statement) still
+    # dominates because the exception can only fire at/after it
+    cfg, read = _cfg_and_node(
+        """
+        def f(x):
+            try:
+                guard()
+                other()
+            except ValueError:
+                pass
+            return read(x)
+        """, "read")
+    assert "other" not in cfg.calls_dominating(read)
+
+
+def test_exit_dominating_calls_establishes_guard():
+    cfg, _ = _cfg_and_node(
+        """
+        def f(x):
+            guard()
+            return read(x)
+        """, "read")
+    assert "guard" in cfg.exit_dominating_calls()
+
+    cfg2, _ = _cfg_and_node(
+        """
+        def f(x):
+            if x:
+                guard()
+            return read(x)
+        """, "read")
+    assert "guard" not in cfg2.exit_dominating_calls()
+
+
+def test_propagate_guard_establishers_interprocedural():
+    src = textwrap.dedent("""
+        class VersionMismatchError(RuntimeError):
+            pass
+
+
+        def check(v):
+            if v:
+                raise VersionMismatchError("stale")
+
+
+        def ensure(v):
+            check(v)
+
+
+        def branch_only(v):
+            if v:
+                check(v)
+    """)
+    project = analyze([SourceFile(path="m.py", text=src,
+                                  tree=ast.parse(src))])
+    names = propagate_guard_establishers(project, {"check"})
+    assert "ensure" in names  # guards on every exit -> is a guard
+    assert "branch_only" not in names  # one branch only -> is not
+
+
+# -- staleness family --------------------------------------------------------
+
+def test_staleness_fixtures():
+    pos = lint_paths([fx("staleness_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "stale-version-read"]
+    # guard in one branch + guard after the read
+    assert len(hits) == 2
+    assert {("lookup" in f.message or "lookup_late" in f.message)
+            for f in hits} == {True}
+    assert all("dominating version check" in f.message for f in hits)
+
+    neg = lint_paths([fx("staleness_neg.py")])
+    assert "stale-version-read" not in rules_hit(neg)
+
+
+def test_staleness_pos_is_invisible_to_v1_rules():
+    """The acceptance seed: the PR-8 version-guard violation that v1
+    graftlint (reachability only, no dominance) cannot catch but v2
+    does."""
+    v1_rules = list(FAMILIES["trace"]) + list(FAMILIES["consistency"])
+    v1 = lint_paths([fx("staleness_pos.py")], select=v1_rules)
+    assert not v1.findings  # v1 is blind to it
+    v2 = lint_paths([fx("staleness_pos.py")], select=["staleness"])
+    assert len(v2.findings) == 2  # v2 catches both shapes
+
+
+# -- transaction family ------------------------------------------------------
+
+def test_transaction_fixtures():
+    pos = lint_paths([fx("txn_checkpoint_pos.py")])
+    assert rules_hit(pos) == {"non-atomic-publish", "commit-marker-order",
+                              "replace-without-fsync"}
+    assert len(pos.findings) == 3
+
+    neg = lint_paths([fx("txn_checkpoint_neg.py")])
+    assert not neg.findings  # helper + temp + fsync + marker-last + append
+
+
+def test_transaction_scope_is_limited():
+    """A module outside the transactional scope (no save-path name, no
+    os.replace) may write bare paths freely — ledgers and reports are a
+    different idiom."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "report.py")
+        with open(p, "w") as fh:
+            fh.write("def dump(path, text):\n"
+                     "    with open(path, 'w') as out:\n"
+                     "        out.write(text)\n")
+        res = lint_paths([p])
+        assert "non-atomic-publish" not in rules_hit(res)
+
+
+# -- concurrency family ------------------------------------------------------
+
+def test_executor_fixtures():
+    pos = lint_paths([fx("executor_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "executor-lifecycle"]
+    assert len(hits) == 2  # class-owned without close + local never shut
+    assert any("Leaky._pool" in f.message for f in hits)
+    assert any("run_batch" in f.message for f in hits)
+
+    neg = lint_paths([fx("executor_neg.py")])
+    assert "executor-lifecycle" not in rules_hit(neg)
+
+
+def test_lock_fixtures():
+    pos = lint_paths([fx("lock_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "lock-held-across-call"]
+    assert len(hits) == 2  # direct re-entry + one call deep
+    assert any("self.flush()" in f.message for f in hits)
+    assert any("self.helper()" in f.message for f in hits)
+
+    neg = lint_paths([fx("lock_neg.py")])
+    assert "lock-held-across-call" not in rules_hit(neg)
+
+
+def test_metric_name_fixtures():
+    pos = lint_paths([fx("metric_name_pos.py")])
+    hits = [f for f in pos.findings if f.rule == "metric-name-constant"]
+    assert len(hits) == 2
+    assert any("ROUTED_OVERFLOW" in f.message for f in hits)  # use const
+    assert any("matches no declared" in f.message for f in hits)  # drift
+
+    neg = lint_paths([fx("metric_name_neg.py")])
+    assert "metric-name-constant" not in rules_hit(neg)
+
+
+# -- family selection --------------------------------------------------------
+
+def test_family_select_and_ignore():
+    pos = lint_paths([fx("txn_checkpoint_pos.py")], select=["transaction"])
+    assert len(pos.findings) == 3
+    none = lint_paths([fx("txn_checkpoint_pos.py")], select=["staleness"])
+    assert not none.findings
+    ignored = lint_paths([fx("txn_checkpoint_pos.py")],
+                         ignore=["transaction"])
+    assert not ignored.findings
+    with pytest.raises(ValueError):
+        lint_paths([fx("txn_checkpoint_pos.py")], select=["bogus-family"])
+
+
+def test_families_cover_registry_exactly():
+    members = [r for fam in FAMILIES.values() for r in fam]
+    assert sorted(members) == sorted(RULES)  # no orphans, no dupes
+
+
+def test_cli_list_rules_groups_by_family(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for fam in FAMILIES:
+        assert f"[{fam}]" in out
+    for rule in RULES:
+        assert rule in out
+
+
+# -- SARIF output ------------------------------------------------------------
+
+def test_sarif_output(tmp_path, capsys):
+    sarif_path = tmp_path / "lint.sarif"
+    rc = main([fx("txn_checkpoint_pos.py"), "--sarif", str(sarif_path)])
+    capsys.readouterr()
+    assert rc == 1  # findings still fail the run
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == set(RULES)
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {
+        "non-atomic-publish", "commit-marker-order",
+        "replace-without-fsync"}
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("txn_checkpoint_pos.py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_sarif_marks_suppressed_results(tmp_path, capsys):
+    src = textwrap.dedent("""\
+        import os
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            # graftlint: disable=env-at-trace -- fixture: frozen by design
+            flag = os.environ.get("FLAG", "0")
+            return x if flag == "0" else -x
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    sarif_path = tmp_path / "out.sarif"
+    assert main([str(p), "--sarif", str(sarif_path)]) == 0
+    capsys.readouterr()
+    doc = json.loads(sarif_path.read_text())
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["suppressions"][0]["kind"] == "inSource"
+
+
+# -- debt report -------------------------------------------------------------
+
+def test_debt_report(tmp_path, capsys):
+    src = textwrap.dedent("""\
+        import os
+        import jax
+
+
+        # graftlint: eager -- fixture: between-batch tuner
+        def tuner(store):
+            return os.environ.get("K")
+
+
+        @jax.jit
+        def step(x):
+            # graftlint: disable=env-at-trace -- fixture: frozen by design
+            flag = os.environ.get("FLAG", "0")
+            return x if flag == "0" else -x
+    """)
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    assert main([str(p), "--json", "--debt"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["version"] == 2
+    debt = out["debt"]
+    assert debt["total"] == 2
+    kinds = {rec["kind"] for rec in debt["annotations"]}
+    assert kinds == {"disable", "eager"}
+    reasons = {rec["reason"] for rec in debt["annotations"]}
+    assert "fixture: frozen by design" in reasons
+    # text mode renders the table
+    assert main([str(p), "--debt"]) == 0
+    text = capsys.readouterr().out
+    assert "graftlint debt: 2 reasoned annotation(s)" in text
+    assert "env-at-trace" in text
+
+
+def test_annotations_ride_lint_result():
+    res = lint_paths([fx("env_at_trace_neg.py"), fx("staleness_neg.py")])
+    # no annotations in these fixtures; the field exists and is a list
+    assert res.annotations == []
+    assert res.to_dict()["annotations"] == []
+
+
+# -- --changed mode ----------------------------------------------------------
+
+@pytest.mark.skipif(shutil.which("git") is None, reason="git unavailable")
+def test_changed_mode_reports_only_diffed_files(tmp_path, monkeypatch,
+                                                capsys):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    violation = ("import concurrent.futures\n\n\n"
+                 "def leak(items):\n"
+                 "    pool = concurrent.futures.ThreadPoolExecutor()\n"
+                 "    return [pool.submit(it) for it in items]\n")
+    (repo / "a.py").write_text(violation)
+    (repo / "b.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "base")
+    # b.py grows a violation in the worktree; a.py's is pre-existing
+    (repo / "b.py").write_text(violation.replace("leak", "leak_b"))
+    monkeypatch.chdir(repo)
+    assert main([str(repo), "--changed", "HEAD", "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    paths = {f["path"] for f in out["findings"]}
+    assert all(p.endswith("b.py") for p in paths), paths
+    assert out["findings"]  # b's finding IS reported
+    # full run still sees both
+    assert main([str(repo), "--json"]) == 1
+    full = json.loads(capsys.readouterr().out)
+    assert len(full["findings"]) == 2
+
+
+def test_changed_mode_bad_base_is_usage_error(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    rc = main([str(p), "--changed", "no-such-base-ref-xyz"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+# -- the fixed true positive stays fixed -------------------------------------
+
+def test_build_graph_cache_publish_is_fsynced():
+    """Regression for the PR-9 true positive: benchmarks/common.py's
+    graph-cache publish fsyncs before its os.replace (a crash must not
+    surface a torn cache at the final name)."""
+    repo = os.path.dirname(HERE)
+    res = lint_paths([os.path.join(repo, "benchmarks", "common.py")],
+                     select=["transaction"])
+    assert res.findings == [], [
+        f"{f.path}:{f.line}: {f.rule}" for f in res.findings]
