@@ -39,8 +39,10 @@ def _body(args):
 
     import jax
 
+    import jax.numpy as jnp
+
     from benchmarks.common import model_from_name
-    from quiver_tpu.parallel.train import init_model
+    from quiver_tpu.parallel.train import empty_adjs, init_model
 
     topo = build_graph(args)
     n = topo.node_count
@@ -50,19 +52,11 @@ def _body(args):
     model, infer, edge_sweeps = model_from_name(
         args.model, args.hidden, args.classes, args.layers, heads=args.heads)
 
-    # params via a tiny sampled batch (inference reuses conv{i} weights)
-    from quiver_tpu import GraphSageSampler
-
-    sampler = GraphSageSampler(topo, [5] * args.layers, seed=args.seed,
-                               frontier_caps="auto")
-    out = sampler.sample(np.arange(min(128, n)))
-    import jax.numpy as jnp
-
-    n_id = np.asarray(out.n_id)
-    x0 = jnp.asarray(
-        np.where((n_id >= 0)[:, None], x_all[np.maximum(n_id, 0)], 0)
-    )
-    params = init_model(model, jax.random.PRNGKey(0), x0, out.adjs)
+    # params from empty-Adj shapes (the trainer's init path) — flax only
+    # needs static shapes, so no throwaway sampler + 128-seed sample
+    adjs = empty_adjs([5] * args.layers, batch=8, node_count=n)
+    x0 = jnp.zeros((adjs[0].size[0], args.feature_dim), jnp.float32)
+    params = init_model(model, jax.random.PRNGKey(0), x0, adjs)
 
     t0 = time.time()
     for _ in range(max(args.warmup, 1)):  # >= 1: the first pass compiles

@@ -1,0 +1,185 @@
+"""Online serving latency/throughput benchmark (quiver-serve).
+
+Drives :class:`quiver_tpu.serving.InferenceServer` — the deadline-aware
+micro-batch path over the resident sampler + tiered feature store — in
+two arrival modes:
+
+* ``--arrival closed`` (default): a closed loop keeps the top ladder
+  bucket full — the max-throughput operating point (queries/sec/chip).
+* ``--arrival open``: fixed-rate arrivals (``--rate`` qps) through the
+  real clock — the latency-under-load operating point where the deadline
+  coalescer actually earns its keep.
+
+Metric: queries/sec/chip, with per-request p50/p95/p99 latency and the
+p99-vs-SLO verdict in the extras, plus ``recompiles_steady`` (must be 0:
+after warmup the ladder only replays compiled programs). ``--parity``
+additionally asserts a sample of ladder responses bitwise against the
+direct single-query oracle — the CI serve-smoke gate. No reference
+baseline exists (the reference never served online); this row tracks the
+framework's own capability.
+"""
+
+import time
+
+from benchmarks.common import base_parser, build_graph, emit, log, run_guarded
+
+
+def main():
+    p = base_parser(__doc__)
+    p.add_argument("--feature-dim", type=int, default=100)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--classes", type=int, default=47)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--fanout", type=int, default=5)
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="top of the power-of-two ladder")
+    p.add_argument("--requests", type=int, default=512,
+                   help="measured point queries (after warmup)")
+    p.add_argument("--arrival", default="closed", choices=["closed", "open"])
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="open-loop arrival rate (queries/sec)")
+    p.add_argument("--deadline-ms", type=float, default=50.0,
+                   help="per-request deadline budget")
+    p.add_argument("--slo-ms", type=float, default=100.0,
+                   help="p99 latency SLO the row reports against")
+    p.add_argument("--parity", action="store_true",
+                   help="assert a sample of responses bitwise against the "
+                   "direct single-query oracle (CI smoke gate)")
+    p.set_defaults(iters=1, warmup=1)
+    args = p.parse_args()
+    run_guarded(lambda: _body(args), args)
+
+
+def _closed_loop(server, nodes, top):
+    """Keep the top bucket full; drain with forced flushes."""
+    done = []
+    for i in range(0, len(nodes), top):
+        for n in nodes[i:i + top]:
+            server.submit(int(n))
+        while server.batcher.depth:
+            done += server.pump(force=True)
+    return done
+
+
+def _open_loop(server, nodes, rate):
+    """Fixed-rate arrivals on the real clock; the deadline coalescer
+    decides the flushes."""
+    done = []
+    t0 = time.monotonic()
+    gap = 1.0 / rate
+    for i, n in enumerate(nodes):
+        due = t0 + i * gap
+        while True:
+            now = time.monotonic()
+            if now >= due:
+                break
+            if server.batcher.ready():
+                done += server.pump()
+            else:
+                time.sleep(min(due - now, gap / 4))
+        server.submit(int(n))
+    while server.batcher.depth:
+        done += server.pump(force=server.batcher.depth < server.batcher.buckets[-1])
+    return done
+
+
+def _body(args):
+    import numpy as np
+
+    import jax
+
+    from quiver_tpu import Feature, GraphSageSampler
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.train import empty_adjs, init_model
+    from quiver_tpu.serving import InferenceServer
+
+    topo = build_graph(args)
+    n = topo.node_count
+    if getattr(args, "smoke", False):
+        args.requests = min(args.requests, 64)
+    rng = np.random.default_rng(args.seed)
+    x_all = rng.normal(size=(n, args.feature_dim)).astype(np.float32)
+    feat = Feature(device_cache_size="8G").from_cpu_tensor(x_all)
+    sampler = GraphSageSampler(topo, [args.fanout] * args.layers,
+                               seed=args.seed)
+    model = GraphSAGE(hidden=args.hidden, num_classes=args.classes,
+                      num_layers=args.layers)
+    adjs = empty_adjs([args.fanout] * args.layers, batch=8, node_count=n)
+    params = init_model(
+        model, jax.random.PRNGKey(args.seed),
+        np.zeros((adjs[0].size[0], args.feature_dim), np.float32), adjs,
+    )
+
+    server = InferenceServer(
+        sampler, model, params, feat, max_batch=args.max_batch,
+        default_deadline_s=args.deadline_ms / 1e3, seed=args.seed,
+    )
+    t0 = time.time()
+    compiles = server.warmup()
+    log(f"warmup: {compiles} ladder programs compiled in "
+        f"{time.time() - t0:.1f}s (buckets {server.batcher.buckets})")
+    # a throwaway round flushes first-touch costs (gather-path tracing,
+    # executable first replay) out of the measured window
+    warm_nodes = rng.integers(0, n, args.max_batch)
+    _closed_loop(server, warm_nodes, args.max_batch)
+    recompiles_warm = server.recompiles
+    misses_warm = server.stats()["deadline_misses"]
+
+    nodes = rng.integers(0, n, args.requests)
+    t0 = time.time()
+    if args.arrival == "closed":
+        done = _closed_loop(server, nodes, args.max_batch)
+    else:
+        done = _open_loop(server, nodes, args.rate)
+    wall = time.time() - t0
+    assert len(done) == args.requests, (len(done), args.requests)
+
+    recompiles_steady = server.recompiles - recompiles_warm
+    lat_ms = np.array([r.latency_s() * 1e3 for r in done])
+    p50, p95, p99 = (float(np.percentile(lat_ms, q)) for q in (50, 95, 99))
+    qps = args.requests / wall
+    chips = jax.device_count()
+    misses = server.stats()["deadline_misses"] - misses_warm
+
+    parity = None
+    if args.parity:
+        checked = 0
+        for r in done[:: max(1, len(done) // 16)]:
+            oracle = server.oracle(r.node, r.seq)
+            if not np.array_equal(r.result, oracle):
+                raise AssertionError(
+                    f"parity violation: node {r.node} seq {r.seq} ladder "
+                    f"response != direct oracle"
+                )
+            checked += 1
+        parity = f"ok:{checked}"
+        log(f"parity: {checked} responses bitwise equal to the oracle")
+    if recompiles_steady:
+        raise AssertionError(
+            f"steady-state recompiles: {recompiles_steady} (ladder must "
+            f"only replay after warmup)"
+        )
+
+    log(server.timeline.report())
+    emit(
+        "serve-latency",
+        qps / chips,
+        "qps/chip",
+        None,
+        arrival=args.arrival,
+        max_batch=args.max_batch,
+        p50_ms=round(p50, 3),
+        p95_ms=round(p95, 3),
+        p99_ms=round(p99, 3),
+        slo_ms=args.slo_ms,
+        p99_within_slo=bool(p99 <= args.slo_ms),
+        deadline_miss_rate=round(misses / args.requests, 4),
+        recompiles_steady=recompiles_steady,
+        requests=args.requests,
+        **({"parity": parity} if parity else {}),
+        **({"rate_qps": args.rate} if args.arrival == "open" else {}),
+    )
+
+
+if __name__ == "__main__":
+    main()

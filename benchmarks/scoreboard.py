@@ -89,6 +89,13 @@ JOBS = [
      "no reference baseline (hetero is beyond-parity)"),
     ("infer-layerwise", "benchmarks.bench_infer", [],
      "full-graph layer-wise inference (reference never benchmarked it)"),
+    ("serve-latency", "benchmarks.bench_serve",
+     ["--arrival", "closed", "--parity"],
+     "online point-query serving: deadline-aware micro-batching over "
+     "per-bucket AOT ladder programs (recompiles must stay 0 after "
+     "warmup), p50/p95/p99 vs SLO + bitwise ladder==oracle parity; the "
+     "reference's closest analogue is its IPC-shared Feature — it never "
+     "shipped an end-to-end serving path"),
     ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"],
      "no reference baseline (SAINT never landed there)"),
     ("validation", "benchmarks.tpu_validation", [],

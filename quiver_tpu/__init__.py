@@ -44,6 +44,12 @@ from .resilience import (
 )
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
+from .serving import (
+    DeadlineBatcher,
+    EmbeddingRefresher,
+    InferenceServer,
+    ServeQueueFull,
+)
 from .streaming import (
     CommitAborted,
     DeltaBatch,
@@ -117,6 +123,10 @@ __all__ = [
     "StreamingGraph",
     "CommitAborted",
     "VersionMismatchError",
+    "InferenceServer",
+    "DeadlineBatcher",
+    "EmbeddingRefresher",
+    "ServeQueueFull",
 ]
 
 __version__ = "0.1.0"

@@ -56,6 +56,10 @@ __all__ = [
     "DELTAS_QUARANTINED",
     "DELTAS_COMMITTED",
     "STREAMING_COMMITS",
+    "SERVE_REQUESTS",
+    "SERVE_DEADLINE_MISSES",
+    "SERVE_DEGRADED_LOOKUPS",
+    "SERVE_RECOMPILES",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -82,6 +86,15 @@ DEGRADED_LOOKUPS = "resilience.degraded_lookups"
 DELTAS_QUARANTINED = "streaming.deltas_quarantined"
 DELTAS_COMMITTED = "streaming.deltas_committed"
 STREAMING_COMMITS = "streaming.commits"
+# online serving layer (quiver_tpu/serving): completed point queries,
+# requests finished after their admission deadline, feature lookups a
+# serve batch satisfied through the circuit breaker's degraded fallback,
+# and ladder-program compilations (zero after warmup = the steady-state
+# never-recompile contract of the compiled micro-batch step)
+SERVE_REQUESTS = "serve.requests"
+SERVE_DEADLINE_MISSES = "serve.deadline_misses"
+SERVE_DEGRADED_LOOKUPS = "serve.degraded_lookups"
+SERVE_RECOMPILES = "serve.recompiles"
 
 _KINDS = ("counter", "gauge")
 

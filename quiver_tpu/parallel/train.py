@@ -22,12 +22,35 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["make_train_step", "make_eval_step", "init_model"]
+__all__ = ["make_train_step", "make_eval_step", "empty_adjs", "init_model"]
 
 
 def init_model(model, rng, x, adjs):
     variables = model.init({"params": rng}, x, adjs)
     return variables["params"]
+
+
+def empty_adjs(sizes, batch: int, node_count: int | None = None):
+    """Deepest-first all-invalid Adj records with the sampler's static
+    shapes — parameter initialization needs only shapes, so
+    ``init_model(model, rng, zeros((caps[-1], F)), empty_adjs(...))``
+    builds params without constructing a sampler or drawing a sample
+    (the DistributedTrainer's init path). Caps follow the sampler's
+    worst-case growth plan: ``prev * (fanout + 1)`` clamped at
+    ``node_count``, rounded up to 8."""
+    from ..sampling.sampler import Adj, _round_up
+
+    adjs, prev = [], int(batch)
+    for k in sizes:
+        k = int(k)
+        cap = prev * (k + 1)
+        if node_count is not None:
+            cap = max(min(cap, int(node_count)), prev)
+        cap = _round_up(cap, 8)
+        ei = jnp.full((2, prev * k), -1, jnp.int32)
+        adjs.append(Adj(ei, None, (cap, prev), fanout=k))
+        prev = cap
+    return adjs[::-1]
 
 
 def cross_entropy_on_seeds(logits, labels, label_mask):

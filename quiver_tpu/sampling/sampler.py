@@ -15,6 +15,7 @@ No IPC/lazy-child-reinit machinery is needed (reference sage_sampler.py:71-79,
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import NamedTuple, Sequence
 
 import numpy as np
@@ -226,6 +227,11 @@ class GraphSageSampler:
         :class:`~quiver_tpu.sampling.dist.DistGraphSageSampler` and
         requires ``mesh=``; results are bit-identical to the replicated
         sampler per worker block.
+      compiled_cache_size: LRU bound on the per-instance compiled-program
+        cache (keyed on (seed_cap, caps)); evictions are counted on
+        ``compiled_cache_evictions``. Auto-cap replans and the serving
+        ladder both grow this cache — unbounded, every superseded program
+        stays pinned.
     """
 
     def __new__(cls, *args, **kwargs):
@@ -254,6 +260,7 @@ class GraphSageSampler:
         dedup: str = "auto",
         device_topo=None,
         topo_sharding: str = "replicated",
+        compiled_cache_size: int = 8,
     ):
         if topo_sharding not in ("replicated", "mesh"):
             raise ValueError(
@@ -324,7 +331,16 @@ class GraphSageSampler:
                 "is implicit; nothing reads this argument",
                 device,
             )
-        self._compiled_cache = {}
+        if compiled_cache_size < 1:
+            raise ValueError(
+                f"compiled_cache_size must be >= 1, got {compiled_cache_size}"
+            )
+        self.compiled_cache_size = int(compiled_cache_size)
+        self.compiled_cache_evictions = 0
+        # LRU-bounded: the serving ladder and auto-cap replans key programs
+        # on (seed_cap, caps), and an unbounded per-instance dict would pin
+        # every superseded program (and its captured constants) forever
+        self._compiled_cache = OrderedDict()
 
     def _init_topo(self, device_topo):
         """Build (or adopt) the device-resident topology. The mesh-sharded
@@ -417,8 +433,10 @@ class GraphSageSampler:
         # class-level cache forever; auto mode re-plans caps per seed_cap)
         caps = self._caps_for(seed_cap)
         cache_key = (seed_cap, caps)
-        if cache_key in self._compiled_cache:
-            return self._compiled_cache[cache_key]
+        hit = self._compiled_cache.get(cache_key)
+        if hit is not None:
+            self._compiled_cache.move_to_end(cache_key)
+            return hit
         sizes = self.sizes
         weighted = self.weighted
         kernel = self.kernel
@@ -432,6 +450,9 @@ class GraphSageSampler:
                                      with_eid=with_eid, dedup=dedup)
 
         self._compiled_cache[cache_key] = (run, caps)
+        while len(self._compiled_cache) > self.compiled_cache_size:
+            self._compiled_cache.popitem(last=False)
+            self.compiled_cache_evictions += 1
         return run, caps
 
     # -- public API ----------------------------------------------------------

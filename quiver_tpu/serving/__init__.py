@@ -1,0 +1,42 @@
+"""quiver-serve: low-latency online inference over resident graph state.
+
+The north-star workload is "heavy traffic from millions of users" — an
+online *serving* path next to the training loop. The reference's
+analogue is its IPC-shared ``Feature``: many frontends, one resident
+cache. Here the resident state is richer (device CSR topology, the
+three-tier feature store, compiled programs), and the serving stack is
+built from three pieces:
+
+* :class:`ServeLadder` — per-bucket AOT-compiled sample/forward
+  executables in a power-of-two batch-size ladder; steady state replays
+  programs, never recompiles, never re-dispatches Python per request.
+* :class:`DeadlineBatcher` — deadline-aware request coalescing with
+  bounded-queue backpressure, deterministic under an injectable clock.
+* :class:`EmbeddingRefresher` — a background lane keeping full-graph
+  layer-wise embedding tables fresh across streaming commits (PR 8
+  ``VersionMismatchError`` -> ``refresh()`` discipline).
+
+:class:`InferenceServer` composes them, attributes every batch across
+six graftscope timeline stages, and lands the ``serve.*`` counters on a
+:class:`~quiver_tpu.obs.registry.MetricsRegistry`.
+"""
+
+from .coalesce import (
+    DeadlineBatcher,
+    ServeQueueFull,
+    ServeRequest,
+    ladder_buckets,
+)
+from .ladder import ServeLadder
+from .refresh import EmbeddingRefresher
+from .server import InferenceServer
+
+__all__ = [
+    "DeadlineBatcher",
+    "EmbeddingRefresher",
+    "InferenceServer",
+    "ServeLadder",
+    "ServeQueueFull",
+    "ServeRequest",
+    "ladder_buckets",
+]

@@ -1,0 +1,193 @@
+"""Deadline-aware request coalescing for the online serving path.
+
+Single-node point queries arrive one at a time; the compiled micro-batch
+step (``serving/ladder.py``) wants power-of-two batches. The
+:class:`DeadlineBatcher` bridges the two: it admits requests into a
+bounded FIFO and releases them as a batch when either (a) enough requests
+are pending to fill the largest ladder bucket, or (b) the *oldest*
+pending request has spent its configured fraction of its deadline budget
+waiting — the classic latency/throughput coalescing knob, here fully
+deterministic under an injectable clock so the packing decision sequence
+is a pure function of the arrival sequence (tests replay it bitwise).
+
+Backpressure is a bounded queue: ``submit`` raises
+:class:`ServeQueueFull` instead of growing without limit — an overloaded
+server sheds load at admission, where the caller can still retry or
+route elsewhere, not at completion where the work is already sunk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["DeadlineBatcher", "ServeQueueFull", "ServeRequest"]
+
+
+class ServeQueueFull(RuntimeError):
+    """Admission rejected: the serving queue is at its bound. The caller
+    owns the retry/shed decision — an unbounded queue would convert
+    overload into unbounded latency for every later request instead."""
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One admitted point query and (after completion) its outcome.
+
+    ``seq`` is the admission sequence number — it is folded into the
+    server's base PRNG key (``fold_in(base_key, seq)``), so a request's
+    sampled neighborhood is a function of (node, seq) alone, independent
+    of which bucket it lands in and of its co-batched neighbors. That
+    independence is what makes ladder-served responses bitwise equal to
+    the direct single-query oracle.
+    """
+
+    node: int
+    seq: int
+    t_admit: float
+    deadline_s: float
+    result: np.ndarray | None = None
+    overflow: int = 0
+    t_done: float | None = None
+    missed: bool | None = None
+
+    @property
+    def deadline_at(self) -> float:
+        return self.t_admit + self.deadline_s
+
+    @property
+    def done(self) -> bool:
+        return self.t_done is not None
+
+    def latency_s(self) -> float | None:
+        return None if self.t_done is None else self.t_done - self.t_admit
+
+
+def ladder_buckets(max_batch: int) -> tuple[int, ...]:
+    """The power-of-two bucket ladder up to ``max_batch``: (1, 2, 4, ...).
+
+    ``max_batch`` must itself be a power of two — a non-power-of-two top
+    bucket would make the padded tail of full batches permanent.
+    """
+    m = int(max_batch)
+    if m < 1 or (m & (m - 1)) != 0:
+        raise ValueError(f"max_batch must be a power of two >= 1, got {max_batch}")
+    out, b = [], 1
+    while b <= m:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+class DeadlineBatcher:
+    """Bounded FIFO that packs point queries into ladder buckets.
+
+    Args:
+      buckets: ascending batch-size ladder (see :func:`ladder_buckets`);
+        the last entry is the largest batch a flush releases.
+      default_deadline_s: per-request deadline when ``submit`` gives none.
+      budget_fraction: fraction of a request's deadline it may spend
+        *queued* before its presence forces a flush (the rest of the
+        budget is reserved for sample/gather/forward/readback).
+      max_queue: admission bound; ``submit`` past it raises
+        :class:`ServeQueueFull`.
+      clock: injectable monotonic clock — tests drive a fake clock and
+        the flush sequence becomes deterministic in the arrival sequence.
+    """
+
+    def __init__(self, buckets=(1, 2, 4, 8), default_deadline_s: float = 0.05,
+                 budget_fraction: float = 0.5, max_queue: int = 256,
+                 clock=time.monotonic):
+        buckets = tuple(int(b) for b in buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"buckets must be ascending and unique, got {buckets}")
+        if any(b < 1 or (b & (b - 1)) != 0 for b in buckets):
+            raise ValueError(f"buckets must be powers of two, got {buckets}")
+        if not 0.0 < budget_fraction <= 1.0:
+            raise ValueError(
+                f"budget_fraction must be in (0, 1], got {budget_fraction}"
+            )
+        if max_queue < buckets[-1]:
+            raise ValueError(
+                f"max_queue ({max_queue}) must hold at least one full "
+                f"top bucket ({buckets[-1]})"
+            )
+        self.buckets = buckets
+        self.default_deadline_s = float(default_deadline_s)
+        self.budget_fraction = float(budget_fraction)
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._pending: list[ServeRequest] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, node: int, deadline_s: float | None = None) -> ServeRequest:
+        """Admit one point query; raises :class:`ServeQueueFull` at the
+        bound. Returns the request handle the caller polls for results."""
+        deadline = self.default_deadline_s if deadline_s is None else float(
+            deadline_s
+        )
+        if deadline <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline}")
+        now = self.clock()
+        with self._lock:
+            if len(self._pending) >= self.max_queue:
+                raise ServeQueueFull(
+                    f"serving queue at bound ({self.max_queue}); shed or "
+                    f"retry after a drain"
+                )
+            req = ServeRequest(int(node), self._seq, now, deadline)
+            self._seq += 1
+            self._pending.append(req)
+        return req
+
+    # -- flush decision ------------------------------------------------------
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def ready(self) -> bool:
+        """True when a flush is due: the top bucket would be full, or the
+        oldest request has burned its queue-wait fraction of its deadline."""
+        now = self.clock()
+        with self._lock:
+            return self._ready_locked(now)
+
+    def _ready_locked(self, now: float) -> bool:
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.buckets[-1]:
+            return True
+        oldest = self._pending[0]
+        return now >= oldest.t_admit + self.budget_fraction * oldest.deadline_s
+
+    def bucket_for(self, count: int) -> int:
+        """Smallest ladder bucket holding ``count`` requests."""
+        for b in self.buckets:
+            if count <= b:
+                return b
+        return self.buckets[-1]
+
+    def pop(self, force: bool = False) -> tuple[list[ServeRequest], int] | None:
+        """Release the next batch, FIFO: up to one top bucket of requests
+        plus the smallest bucket that holds them. ``None`` when nothing is
+        due (``force`` flushes whatever is pending — the closed-loop
+        drain path). Deterministic: the decision uses only the injectable
+        clock and the admission order."""
+        now = self.clock()
+        with self._lock:
+            if not self._pending:
+                return None
+            if not force and not self._ready_locked(now):
+                return None
+            take = min(len(self._pending), self.buckets[-1])
+            batch = self._pending[:take]
+            del self._pending[:take]
+        return batch, self.bucket_for(take)

@@ -1,0 +1,166 @@
+"""Background embedding-refresh lane for the serving path.
+
+Point queries answered by sampled k-hop forward are fresh by
+construction — they read the live topology and store. A second class of
+serving reads wants *precomputed* embeddings: the full-graph layer-wise
+tables that ``models/inference.py`` produces (the reference's
+``model.inference`` path — each layer computed once over ALL nodes, far
+cheaper per node than sampled forward at high query rates).
+
+A precomputed table is a *placement* in the PR 8 sense: it captures the
+host CSR at one committed version, and a ``StreamingGraph.commit()``
+silently invalidates it. :class:`EmbeddingRefresher` applies the
+streaming discipline to that table: lookups raise
+:class:`~quiver_tpu.core.topology.VersionMismatchError` the moment the
+committed version drifts from the table's, :meth:`refresh` recomputes
+(layer-wise, whole graph) and atomically publishes table+version
+together, and :meth:`start` runs that loop on a background thread so the
+serving thread never blocks on a rebuild — it serves sampled answers (or
+stale-raises) while the lane catches up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..core.topology import VersionMismatchError
+from ..models.inference import sage_layerwise_inference
+
+__all__ = ["EmbeddingRefresher"]
+
+
+class EmbeddingRefresher:
+    """Versioned full-graph embedding table with a background refresh loop.
+
+    Args:
+      model / params: the trained module and weights (``infer_fn``
+        consumes them).
+      csr_topo: the HOST CSR the streaming layer mutates — its committed
+        ``version`` is the staleness authority.
+      features: (N, F) input features, or a zero-arg callable returning
+        them — pass a callable bound to the live feature store so a
+        commit's row updates reach the next refresh.
+      infer_fn: layer-wise inference entry point
+        (default :func:`sage_layerwise_inference`; any of the
+        ``models/inference.py`` family fits).
+      chunk / mode: forwarded to ``infer_fn``.
+    """
+
+    def __init__(self, model, params, csr_topo, features, *,
+                 infer_fn=None, chunk: int = 1 << 21, mode: str = "HBM"):
+        self.model = model
+        self.params = params
+        self.csr_topo = csr_topo
+        self._features = features
+        self.infer_fn = infer_fn if infer_fn is not None else (
+            sage_layerwise_inference
+        )
+        self.chunk = int(chunk)
+        self.mode = mode
+        self.refreshes = 0
+        self._table: np.ndarray | None = None
+        self._table_version: int | None = None
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def _features_now(self) -> np.ndarray:
+        f = self._features
+        return np.asarray(f() if callable(f) else f)
+
+    # -- refresh seam --------------------------------------------------------
+
+    def refresh(self) -> int:
+        """Recompute the whole-graph table from the CURRENT committed
+        state and publish table+version atomically; returns the version
+        served. Safe to call from the background thread while lookups
+        proceed against the old table."""
+        version = int(getattr(self.csr_topo, "version", 0))
+        x = self._features_now()
+        logp = self.infer_fn(
+            self.model, self.params, self.csr_topo, x,
+            chunk=self.chunk, mode=self.mode,
+        )
+        table = np.asarray(logp)
+        with self._lock:
+            self._table = table
+            self._table_version = version
+            self.refreshes += 1
+        return version
+
+    # -- versioned reads -----------------------------------------------------
+
+    def check_version(self) -> None:
+        """Raise :class:`VersionMismatchError` when the table is missing
+        or built from a superseded commit — a stale embedding row is a
+        silently wrong answer, not a cheap one."""
+        with self._lock:
+            ver = self._table_version
+        current = int(getattr(self.csr_topo, "version", 0))
+        if ver is None:
+            raise VersionMismatchError(
+                "no embedding table published yet; call refresh() (or "
+                "start() the background lane) before lookup()"
+            )
+        if current != ver:
+            raise VersionMismatchError(
+                f"embedding table built from topology version {ver} but "
+                f"the host CSR has committed version {current}; call "
+                f"refresh() to recompute"
+            )
+
+    @property
+    def version(self) -> int | None:
+        """The committed version the published table reflects."""
+        with self._lock:
+            return self._table_version
+
+    def lookup(self, ids) -> np.ndarray:
+        """Rows of the published table for ``ids`` — raises
+        :class:`VersionMismatchError` instead of serving stale rows."""
+        self.check_version()
+        with self._lock:
+            table = self._table
+        return table[np.asarray(ids)]
+
+    # -- background lane -----------------------------------------------------
+
+    def start(self, interval_s: float = 1.0) -> threading.Thread:
+        """Run the refresh loop on a daemon thread: poll the committed
+        version every ``interval_s`` and recompute when it drifts (the
+        first iteration publishes the initial table)."""
+        if self._thread is not None:
+            raise RuntimeError("refresh lane already running; stop() first")
+        self._stop.clear()
+        t = threading.Thread(
+            target=self._loop, args=(float(interval_s),),
+            name="embedding-refresh", daemon=True,
+        )
+        self._thread = t
+        t.start()
+        return t
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.is_set():
+            try:
+                self.check_version()
+            except VersionMismatchError:
+                self.refresh()
+            self._stop.wait(interval_s)
+
+    def stop(self) -> None:
+        """Stop and join the background lane (idempotent)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+
+    def __enter__(self) -> "EmbeddingRefresher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,322 @@
+"""quiver-serve: the online inference server over resident graph state.
+
+Composes the three serving pieces into one low-latency path:
+
+* :class:`~quiver_tpu.serving.coalesce.DeadlineBatcher` — admission,
+  deadline-aware coalescing, bounded-queue backpressure;
+* :class:`~quiver_tpu.serving.ladder.ServeLadder` — per-bucket AOT
+  compiled sample/forward executables (steady state never recompiles);
+* the host feature gather in between — :class:`~quiver_tpu.feature
+  .feature.Feature`, mesh-sharded ``ShardedFeature``, or the circuit-
+  breaker-wrapped ``DegradedFeature`` all serve it unchanged, so a
+  cold-tier outage degrades responses instead of failing them.
+
+Every batch walks six attributed stages — ``queue_wait``/``pad``/
+``sample``/``gather``/``forward``/``readback`` — on a graftscope
+:class:`~quiver_tpu.obs.timeline.StepTimeline` (P² p50/p95/p99 per
+stage), and the serve counters land on a
+:class:`~quiver_tpu.obs.registry.MetricsRegistry` under the
+``serve.*`` constants.
+
+Staleness follows the PR 8 streaming discipline: the server captures the
+host CSR's committed ``version`` when it (re)builds its compiled ladder;
+after a ``StreamingGraph.commit()`` every serve path raises
+:class:`~quiver_tpu.core.topology.VersionMismatchError` until
+:meth:`InferenceServer.refresh` re-places the topology and recompiles —
+never a silently pre-commit answer.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.topology import VersionMismatchError
+from ..obs.registry import (
+    SERVE_DEADLINE_MISSES,
+    SERVE_DEGRADED_LOOKUPS,
+    SERVE_RECOMPILES,
+    SERVE_REQUESTS,
+    MetricsRegistry,
+)
+from ..obs.timeline import StepTimeline
+from ..resilience.elastic import DegradedFeature
+from .coalesce import DeadlineBatcher, ServeRequest, ladder_buckets
+from .ladder import ServeLadder
+
+__all__ = ["InferenceServer"]
+
+
+class InferenceServer:
+    """Deadline-aware micro-batch serving over a resident sampler+store.
+
+    Args:
+      sampler: replicated :class:`~quiver_tpu.sampling.sampler
+        .GraphSageSampler` holding the device topology to serve from.
+      model: trained module (``apply(x, adjs, train=False)`` log-probs).
+      params: trained parameter tree.
+      feature: ids->rows store — ``Feature``, ``ShardedFeature``, or any
+        ``DegradedFeature``-wrappable host lookup.
+      max_batch: top of the power-of-two bucket ladder.
+      buckets: explicit ladder override (ascending powers of two).
+      default_deadline_s / budget_fraction / max_queue / clock: the
+        :class:`DeadlineBatcher` knobs (clock is injectable — tests and
+        the open-loop benchmark drive a fake one).
+      lane_caps: per-layer single-seed frontier caps (default: the
+        sampler's worst-case single-seed plan).
+      seed: base PRNG seed; request ``seq`` folds into it, so responses
+        are reproducible functions of (node, seq).
+      degraded: ``None`` (store failures propagate), or ``"zeros"`` /
+        ``"last-good"`` — wrap the store in a circuit-breaker
+        :class:`DegradedFeature` so a cold-tier outage serves degraded
+        rows instead of failing requests.
+      breaker_failures / probe_every: breaker thresholds when wrapping.
+      metrics / timeline: external graftscope sinks (private by default).
+    """
+
+    STAGES = ("queue_wait", "pad", "sample", "gather", "forward", "readback")
+
+    def __init__(self, sampler, model, params, feature, *,
+                 max_batch: int = 8, buckets=None,
+                 default_deadline_s: float = 0.05,
+                 budget_fraction: float = 0.5, max_queue: int = 256,
+                 clock=time.monotonic, lane_caps=None, seed: int = 0,
+                 degraded: str | None = None, breaker_failures: int = 3,
+                 probe_every: int = 8,
+                 metrics: MetricsRegistry | None = None,
+                 timeline: StepTimeline | None = None):
+        self.sampler = sampler
+        self.model = model
+        self.params = params
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.timeline = timeline if timeline is not None else StepTimeline()
+        self.clock = clock
+        if degraded is not None and not isinstance(feature, DegradedFeature):
+            feature = DegradedFeature(
+                feature, failures=breaker_failures, probe_every=probe_every,
+                fallback=degraded, metrics=self.metrics,
+            )
+        self.feature = feature
+        self.batcher = DeadlineBatcher(
+            buckets=tuple(buckets) if buckets else ladder_buckets(max_batch),
+            default_deadline_s=default_deadline_s,
+            budget_fraction=budget_fraction,
+            max_queue=max_queue, clock=clock,
+        )
+        self._base_key = jax.random.PRNGKey(seed)
+        self._lane_caps = lane_caps
+        self.metrics.counter(
+            SERVE_REQUESTS, unit="requests",
+            doc="point queries completed by the serving path",
+        )
+        self.metrics.counter(
+            SERVE_DEADLINE_MISSES, unit="requests",
+            doc="requests completed after their admission deadline",
+        )
+        self.metrics.counter(
+            SERVE_DEGRADED_LOOKUPS, unit="lookups",
+            doc="serve-batch feature gathers satisfied by the circuit "
+                "breaker's degraded fallback instead of the real store",
+        )
+        self.metrics.counter(
+            SERVE_RECOMPILES, unit="programs",
+            doc="ladder program compilations (0 after warmup = the "
+                "steady-state never-recompile contract)",
+        )
+        self._requests_total = 0
+        self._misses_total = 0
+        self._recompiles_total = 0
+        self._serve_degraded_total = 0
+        self._degraded_seen = (
+            feature.degraded_total if isinstance(feature, DegradedFeature)
+            else 0
+        )
+        # row dtype/width probe: a single -1 (padding) id returns one
+        # zero row of exactly the dtype the store serves (dequantized
+        # int8 -> f32, bf16 stores -> bf16) without touching real rows
+        probe = np.asarray(self.feature[np.full((1,), -1, np.int32)])
+        self._row_dtype = probe.dtype
+        self._feature_dim = int(probe.shape[1])
+        self._ladder = self._make_ladder()
+        self._topo_version = int(getattr(sampler.csr_topo, "version", 0))
+
+    def _make_ladder(self) -> ServeLadder:
+        ladder = ServeLadder(
+            self.sampler, self.model, self._feature_dim,
+            row_dtype=self._row_dtype, lane_caps=self._lane_caps,
+            on_compile=self._on_ladder_compile,
+        )
+        ladder.bind_params(self.params)
+        return ladder
+
+    def _on_ladder_compile(self) -> None:
+        self._recompiles_total += 1
+        self.metrics.set(SERVE_RECOMPILES, np.int32(self._recompiles_total))
+
+    # -- streaming-mutation versioning --------------------------------------
+
+    def check_version(self) -> None:
+        """Raise :class:`VersionMismatchError` when the host CSR has
+        committed a version the compiled ladder was not built from —
+        serving would silently answer from the pre-commit graph. Call
+        :meth:`refresh` to re-place and recompile."""
+        current = int(getattr(self.sampler.csr_topo, "version", 0))
+        if current != self._topo_version:
+            raise VersionMismatchError(
+                f"serving ladder compiled against topology version "
+                f"{self._topo_version} but the host CSR has committed "
+                f"version {current}; call refresh() before serving"
+            )
+
+    def refresh(self, warmup: bool = True) -> "InferenceServer":
+        """Re-place the device topology and rebuild the compiled ladder
+        after a streaming commit. ``warmup`` recompiles the buckets that
+        were live before (counted in ``serve.recompiles`` — a mutation
+        epoch pays its compiles at the boundary, not per request)."""
+        live = sorted(
+            set(self._ladder._sample_exec) | set(self._ladder._forward_exec)
+        )
+        self.sampler.refresh_topology()
+        self._ladder = self._make_ladder()
+        self._topo_version = int(getattr(self.sampler.csr_topo, "version", 0))
+        if warmup and live:
+            self._ladder.warmup(live)
+        return self
+
+    # -- serving -------------------------------------------------------------
+
+    def submit(self, node: int, deadline_s: float | None = None) -> ServeRequest:
+        """Admit one point query (see :meth:`DeadlineBatcher.submit`)."""
+        return self.batcher.submit(node, deadline_s)
+
+    def warmup(self, buckets=None) -> int:
+        """Pre-compile the ladder (all batcher buckets by default);
+        returns the number of program compilations. Steady-state serving
+        after warmup replays executables only."""
+        self.check_version()
+        return self._ladder.warmup(
+            tuple(buckets) if buckets else self.batcher.buckets
+        )
+
+    def pump(self, force: bool = False) -> list[ServeRequest]:
+        """Serve at most one due batch; returns the completed requests
+        (empty when nothing is due). ``force`` flushes a partial bucket —
+        the closed-loop drain path."""
+        self.check_version()
+        popped = self.batcher.pop(force=force)
+        if popped is None:
+            return []
+        reqs, bucket = popped
+        now = self.clock()
+        for r in reqs:
+            self.timeline.observe("queue_wait", now - r.t_admit)
+        return self._run_batch(reqs, bucket)
+
+    def serve(self, nodes, deadline_s: float | None = None) -> list[ServeRequest]:
+        """Closed-loop convenience: admit ``nodes`` and drain the queue;
+        returns their completed requests in admission order."""
+        reqs = [self.submit(int(n), deadline_s) for n in np.asarray(nodes)]
+        while any(not r.done for r in reqs):
+            self.pump(force=True)
+        return reqs
+
+    def _run_batch(self, reqs, bucket: int) -> list[ServeRequest]:
+        capL = self._ladder.lane_caps[-1]
+        with self.timeline.stage("pad"):
+            seeds = np.full(bucket, -1, np.int32)
+            nvalid = np.zeros(bucket, np.int32)
+            seqs = np.zeros(bucket, np.int32)
+            for i, r in enumerate(reqs):
+                seeds[i] = r.node
+                nvalid[i] = 1
+                seqs[i] = r.seq
+            seeds_d = jnp.asarray(seeds)
+            nvalid_d = jnp.asarray(nvalid)
+            seqs_d = jnp.asarray(seqs)
+        sample_ex = self._ladder.sample_exec(bucket)
+        with self.timeline.stage("sample"):
+            n_ids, eis, overflow = sample_ex(
+                self.sampler.topo, seeds_d, nvalid_d, seqs_d, self._base_key
+            )
+            jax.block_until_ready(n_ids)
+        with self.timeline.stage("gather"):
+            rows = self.feature[n_ids.reshape(-1)]
+            x = jnp.asarray(rows, self._row_dtype).reshape(
+                bucket, capL, self._feature_dim
+            )
+            jax.block_until_ready(x)
+        forward_ex = self._ladder.forward_exec(bucket)
+        with self.timeline.stage("forward"):
+            out = forward_ex(x, eis, self.params)
+            jax.block_until_ready(out)
+        with self.timeline.stage("readback"):
+            out_np = np.asarray(out)
+            ovf_np = np.asarray(overflow)
+        t_done = self.clock()
+        misses = 0
+        for i, r in enumerate(reqs):
+            r.result = out_np[i]
+            r.overflow = int(ovf_np[i])
+            r.t_done = t_done
+            r.missed = t_done > r.deadline_at
+            misses += int(r.missed)
+        self._requests_total += len(reqs)
+        self._misses_total += misses
+        self.metrics.set(SERVE_REQUESTS, np.int32(self._requests_total))
+        self.metrics.set(SERVE_DEADLINE_MISSES, np.int32(self._misses_total))
+        if isinstance(self.feature, DegradedFeature):
+            delta = self.feature.degraded_total - self._degraded_seen
+            if delta:
+                self._degraded_seen = self.feature.degraded_total
+                self._serve_degraded_total += delta
+                self.metrics.set(
+                    SERVE_DEGRADED_LOOKUPS,
+                    np.int32(self._serve_degraded_total),
+                )
+        return reqs
+
+    # -- parity oracle -------------------------------------------------------
+
+    def oracle(self, node: int, seq: int) -> np.ndarray:
+        """The direct (ladder-free) sampled-inference answer for
+        ``(node, seq)`` — single-seed sample at ``fold_in(base_key,
+        seq)``, the same host feature gather, a standalone model forward.
+        The bit-parity differential asserts ladder == oracle at every
+        bucket size and padded tail."""
+        self.check_version()
+        n_id, eis, _overflow = self._ladder.oracle_sample(
+            self.sampler.topo, node, seq, self._base_key
+        )
+        rows = self.feature[n_id]
+        x = jnp.asarray(rows, self._row_dtype).reshape(
+            self._ladder.lane_caps[-1], self._feature_dim
+        )
+        out = self._ladder.oracle_forward(x, eis, self.params)
+        return np.asarray(out)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def recompiles(self) -> int:
+        """Cumulative ladder compilations (the ``serve.recompiles``
+        counter; flat after :meth:`warmup` = steady-state contract)."""
+        return self._recompiles_total
+
+    def stats(self) -> dict:
+        """Host-side serve counters + per-stage latency quantiles."""
+        stages = {
+            name: st.as_dict()
+            for name, st in self.timeline.summary().items()
+        }
+        return {
+            "requests": self._requests_total,
+            "deadline_misses": self._misses_total,
+            "degraded_lookups": self._serve_degraded_total,
+            "recompiles": self._recompiles_total,
+            "queue_depth": self.batcher.depth,
+            "stages": stages,
+        }

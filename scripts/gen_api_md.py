@@ -46,6 +46,9 @@ SECTIONS = [
     ("quiver_tpu.streaming",
      "Transactional streaming graph mutation — delta ingestion, atomic "
      "commits, versioned invalidation"),
+    ("quiver_tpu.serving",
+     "Online inference serving — deadline-aware micro-batching over "
+     "AOT-compiled ladder programs"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),

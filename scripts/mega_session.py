@@ -80,6 +80,7 @@ ORDER = [
     ("sampler-stages", 1500),
     ("rgcn", 900),
     ("infer-layerwise", 900),
+    ("serve-latency", 900),
     ("saint-node", 900),
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),

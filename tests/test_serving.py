@@ -1,0 +1,339 @@
+"""Online serving (quiver_tpu/serving) + the sampler compiled-cache LRU.
+
+Fast lane: ladder-bucket math, deadline-batcher flush decisions and
+determinism under a fake clock, bounded-queue backpressure, the bitwise
+ladder==oracle parity differential at every bucket size and padded tail,
+deadline-miss accounting, the stale-serve drill (streaming commit ->
+VersionMismatchError -> refresh -> serve the mutated graph), the
+embedding-refresher version drill, and the GraphSageSampler LRU bound.
+
+Slow lane: an open-loop run on the real clock through the deadline
+coalescer's own flush decisions.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from quiver_tpu import (
+    CSRTopo,
+    DeltaBatch,
+    Feature,
+    GraphSageSampler,
+    InferenceServer,
+    ServeQueueFull,
+    StreamingGraph,
+    VersionMismatchError,
+)
+from quiver_tpu.models.sage import GraphSAGE
+from quiver_tpu.parallel.train import empty_adjs, init_model
+from quiver_tpu.serving import DeadlineBatcher, EmbeddingRefresher
+from quiver_tpu.serving.coalesce import ladder_buckets
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _graph(n=240, e=1600, seed=0):
+    rng = np.random.default_rng(seed)
+    coo = rng.integers(0, n, size=(2, e)).astype(np.int64)
+    return CSRTopo(edge_index=coo)
+
+
+def _stack(topo, feature_dim=12, hidden=16, classes=5, sizes=(4, 3), seed=1):
+    rng = np.random.default_rng(seed)
+    x_all = rng.normal(size=(topo.node_count, feature_dim)).astype(np.float32)
+    feat = Feature(device_cache_size="1G").from_cpu_tensor(x_all)
+    sampler = GraphSageSampler(topo, list(sizes), seed=seed)
+    model = GraphSAGE(hidden=hidden, num_classes=classes,
+                      num_layers=len(sizes))
+    adjs = empty_adjs(list(sizes), batch=4, node_count=topo.node_count)
+    params = init_model(
+        model, jax.random.PRNGKey(seed),
+        np.zeros((adjs[0].size[0], feature_dim), np.float32), adjs,
+    )
+    return x_all, feat, sampler, model, params
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One warm max_batch=4 server shared by the fast serving tests."""
+    topo = _graph()
+    _x, feat, sampler, model, params = _stack(topo)
+    clock = FakeClock()
+    server = InferenceServer(sampler, model, params, feat,
+                             max_batch=4, clock=clock, seed=3)
+    server.warmup()
+    return server, clock
+
+
+# -- ladder buckets ----------------------------------------------------------
+
+
+def test_ladder_buckets():
+    assert ladder_buckets(1) == (1,)
+    assert ladder_buckets(8) == (1, 2, 4, 8)
+    for bad in (0, 3, 6, -4):
+        with pytest.raises(ValueError):
+            ladder_buckets(bad)
+
+
+# -- deadline batcher (no jax; pure host logic under a fake clock) -----------
+
+
+def test_batcher_flush_decisions():
+    clock = FakeClock()
+    b = DeadlineBatcher(buckets=(1, 2, 4), default_deadline_s=1.0,
+                        budget_fraction=0.5, clock=clock)
+    # a full top bucket flushes regardless of deadlines
+    for n in range(4):
+        b.submit(n)
+    assert b.ready()
+    reqs, bucket = b.pop()
+    assert bucket == 4 and [r.node for r in reqs] == [0, 1, 2, 3]
+    # a partial bucket waits until the oldest burns its queue-wait budget
+    b.submit(7)
+    assert not b.ready() and b.pop() is None
+    clock.advance(0.49)
+    assert not b.ready()
+    clock.advance(0.01)  # 0.5 = budget_fraction * deadline
+    assert b.ready()
+    reqs, bucket = b.pop()
+    assert bucket == 1 and reqs[0].node == 7
+    # force flushes a partial bucket immediately (closed-loop drain)
+    b.submit(8)
+    b.submit(9)
+    b.submit(10)
+    reqs, bucket = b.pop(force=True)
+    assert bucket == 4 and len(reqs) == 3  # smallest bucket holding 3
+
+
+def test_batcher_determinism_under_fake_clock():
+    """Same arrival sequence on a fake clock -> same packing decisions."""
+    script = [(0.000, 5), (0.004, 9), (0.004, 2), (0.030, 11), (0.040, 3)]
+
+    def run():
+        clock = FakeClock()
+        b = DeadlineBatcher(buckets=(1, 2, 4), default_deadline_s=0.05,
+                            budget_fraction=0.5, clock=clock)
+        out, t = [], 0.0
+        for dt, node in script:
+            clock.advance(dt)
+            t += dt
+            b.submit(node)
+            while b.ready():
+                reqs, bucket = b.pop()
+                out.append((round(t, 6), bucket,
+                            tuple((r.node, r.seq) for r in reqs)))
+        clock.advance(1.0)
+        while b.depth:
+            reqs, bucket = b.pop()
+            out.append(("drain", bucket,
+                        tuple((r.node, r.seq) for r in reqs)))
+        return out
+
+    assert run() == run()
+
+
+def test_batcher_backpressure_and_validation():
+    b = DeadlineBatcher(buckets=(1, 2), max_queue=4, clock=FakeClock())
+    for n in range(4):
+        b.submit(n)
+    with pytest.raises(ServeQueueFull):
+        b.submit(99)
+    with pytest.raises(ValueError):
+        b.submit(0, deadline_s=0.0)
+    with pytest.raises(ValueError):
+        DeadlineBatcher(buckets=(2, 1))
+    with pytest.raises(ValueError):
+        DeadlineBatcher(buckets=(3,))
+    with pytest.raises(ValueError):
+        DeadlineBatcher(buckets=(1, 2), max_queue=1)
+    with pytest.raises(ValueError):
+        DeadlineBatcher(budget_fraction=0.0)
+
+
+# -- serving parity ----------------------------------------------------------
+
+
+def test_serve_parity_every_bucket_and_padded_tail(served):
+    """Ladder responses are BITWISE equal to the direct single-query
+    oracle at every bucket size, including padded tails — a response is a
+    function of (node, seq) alone, not of its co-batched neighbors."""
+    server, _clock = served
+    compiles_after_warmup = server.recompiles
+    rng = np.random.default_rng(0)
+    n = server.sampler.csr_topo.node_count
+    for group in (1, 2, 3, 4):  # buckets 1, 2, 4 (padded), 4 (full)
+        reqs = server.serve(rng.integers(0, n, group))
+        assert len(reqs) == group
+        for r in reqs:
+            assert r.done and r.result.ndim == 1
+            np.testing.assert_array_equal(
+                r.result, server.oracle(r.node, r.seq)
+            )
+    # the steady-state contract: replay only, zero recompiles
+    assert server.recompiles == compiles_after_warmup
+    assert server.stats()["requests"] >= 10
+
+
+def test_deadline_miss_accounting(served):
+    server, clock = served
+    misses0 = server.stats()["deadline_misses"]
+    r_hit = server.submit(1, deadline_s=1000.0)
+    r_miss = server.submit(2, deadline_s=0.01)
+    clock.advance(0.5)  # r_miss is past its deadline before the flush
+    done = server.pump(force=True)
+    assert {id(r) for r in done} == {id(r_hit), id(r_miss)}
+    assert r_hit.missed is False and r_miss.missed is True
+    assert r_miss.latency_s() >= 0.5
+    stats = server.stats()
+    assert stats["deadline_misses"] == misses0 + 1
+    assert set(InferenceServer.STAGES) <= set(stats["stages"])
+
+
+def test_two_servers_bitwise_identical(served):
+    """Same seed + same admission sequence -> bitwise-identical responses
+    and identical packing, across two independently compiled servers."""
+    server, _clock = served
+    s = server.sampler
+    mk = lambda: InferenceServer(  # noqa: E731
+        s, server.model, server.params, server.feature,
+        buckets=(2,), clock=FakeClock(), seed=11,
+    )
+    a, b = mk(), mk()
+    nodes = [3, 17, 4, 4]
+    out_a = a.serve(nodes)
+    out_b = b.serve(nodes)
+    for ra, rb in zip(out_a, out_b):
+        assert (ra.node, ra.seq) == (rb.node, rb.seq)
+        np.testing.assert_array_equal(ra.result, rb.result)
+
+
+# -- stale-serve drill -------------------------------------------------------
+
+
+def test_stale_serve_drill():
+    """Commit a DeltaBatch -> every serve path raises -> refresh() ->
+    the server serves the mutated graph (and still matches its oracle)."""
+    topo = _graph(n=60, e=400, seed=4)
+    _x, feat, sampler, model, params = _stack(topo, sizes=(3, 2), seed=4)
+    server = InferenceServer(sampler, model, params, feat,
+                             max_batch=1, clock=FakeClock(), seed=5)
+    server.warmup()
+    before = server.serve([7])[0]
+    np.testing.assert_array_equal(before.result, server.oracle(7, before.seq))
+
+    sg = StreamingGraph(topo)
+    src = np.repeat(np.arange(topo.node_count), topo.degree)
+    dst = np.asarray(topo.indices)[: src.size]
+    live = set((src * topo.node_count + dst).tolist())
+    k = next(k for k in range(topo.node_count ** 2) if k not in live)
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array(
+        [[k // topo.node_count], [k % topo.node_count]])))
+    sg.commit()
+
+    with pytest.raises(VersionMismatchError):
+        server.pump(force=True)
+    with pytest.raises(VersionMismatchError):
+        server.warmup()
+    with pytest.raises(VersionMismatchError):
+        server.oracle(7, 0)
+
+    compiles = server.recompiles
+    server.refresh()
+    # a mutation epoch pays its recompiles at the commit boundary
+    assert server.recompiles > compiles
+    after = server.serve([7])[0]
+    assert after.done
+    np.testing.assert_array_equal(after.result, server.oracle(7, after.seq))
+
+
+# -- embedding refresher -----------------------------------------------------
+
+
+def test_embedding_refresher_version_drill():
+    topo = _graph(n=60, e=400, seed=6)
+    x, _feat, _sampler, model, params = _stack(topo, sizes=(3, 2), seed=6)
+    r = EmbeddingRefresher(model, params, topo, x)
+    with pytest.raises(VersionMismatchError):
+        r.lookup([0])  # no table published yet
+    v0 = r.refresh()
+    assert r.version == v0 and r.refreshes == 1
+    rows = r.lookup([0, 5, 59])
+    assert rows.shape == (3, 5)
+
+    sg = StreamingGraph(topo)
+    src = int(np.repeat(np.arange(topo.node_count), topo.degree)[0])
+    dst = int(np.asarray(topo.indices)[
+        int(np.asarray(topo.indptr, dtype=np.int64)[src])])
+    assert sg.ingest(DeltaBatch(edge_deletes=np.array([[src], [dst]])))
+    sg.commit()
+
+    with pytest.raises(VersionMismatchError):
+        r.lookup([0])
+    v1 = r.refresh()
+    assert v1 > v0 and r.refreshes == 2
+    assert r.lookup([0, 5, 59]).shape == (3, 5)
+
+
+# -- sampler compiled-cache LRU (satellite) ----------------------------------
+
+
+def test_sampler_compiled_cache_lru():
+    topo = _graph(n=60, e=400, seed=8)
+    s = GraphSageSampler(topo, [3, 2], compiled_cache_size=2)
+    run8, _ = s._compiled(8)
+    run16, _ = s._compiled(16)
+    assert len(s._compiled_cache) == 2 and s.compiled_cache_evictions == 0
+    # a hit returns the SAME program object and refreshes recency
+    assert s._compiled(8)[0] is run8
+    s._compiled(24)  # evicts 16 (least recent), not the just-touched 8
+    assert len(s._compiled_cache) == 2 and s.compiled_cache_evictions == 1
+    assert s._compiled(8)[0] is run8
+    assert s._compiled(16)[0] is not run16  # rebuilt after eviction
+    assert s.compiled_cache_evictions >= 2
+    with pytest.raises(ValueError):
+        GraphSageSampler(topo, [3, 2], compiled_cache_size=0)
+
+
+# -- open loop on the real clock --------------------------------------------
+
+
+@pytest.mark.slow
+def test_open_loop_real_clock():
+    """Fixed-rate arrivals on the real clock, flushes decided by the
+    coalescer itself — all requests complete, within deadline, with zero
+    steady-state recompiles."""
+    import time
+
+    topo = _graph()
+    _x, feat, sampler, model, params = _stack(topo)
+    server = InferenceServer(sampler, model, params, feat, max_batch=4,
+                             default_deadline_s=5.0, seed=9)
+    server.warmup()
+    server.serve([0, 1, 2, 3])  # flush first-touch costs
+    compiles = server.recompiles
+    rng = np.random.default_rng(9)
+    reqs, done = [], []
+    for node in rng.integers(0, topo.node_count, 32):
+        reqs.append(server.submit(int(node)))
+        time.sleep(0.002)
+        if server.batcher.ready():
+            done += server.pump()
+    while server.batcher.depth:
+        done += server.pump(force=True)
+    assert len(done) == 32 and all(r.done for r in reqs)
+    assert sum(r.missed for r in done) == 0
+    assert server.recompiles == compiles
+    for r in done[::7]:
+        np.testing.assert_array_equal(r.result, server.oracle(r.node, r.seq))
