@@ -75,6 +75,19 @@ def main():
         "(and like it, accepts cold tiers and HOST topologies)",
     )
     p.add_argument(
+        "--pipeline", action="store_true",
+        help="software-pipelined epoch (DistributedTrainer "
+        "pipeline_depth=1, one-step skew: batch t+1's sample+gather "
+        "issued under batch t's fwd/bwd). ONE invocation measures the "
+        "serial stage estimator (sample/gather/train_step Timer stages), "
+        "the Prefetcher-overlapped host loop, the serial epoch_scan, and "
+        "the pipelined epoch_scan, and emits all four ledger records "
+        "side-by-side — overlap efficiency = serial stage-sum p50 / "
+        "pipelined per-step p50 (>1.0 = sample+gather latency hidden "
+        "under compute). Bitwise-identical losses to the serial scan "
+        "(tests/test_pipelined_epoch.py)",
+    )
+    p.add_argument(
         "--seed-sharding", default="data", choices=["data", "all"],
         help="fused/scan modes: seed-block placement (see "
         "DistributedTrainer) — 'all' makes every device a data worker "
@@ -140,6 +153,9 @@ def _body(args):
     tx = optax.adam(1e-3)
     rng = np.random.default_rng(args.seed + 1)
 
+    if args.pipeline:
+        _pipeline_measure(args, topo, feature, model, tx, labels_all, rng)
+        return
     if args.scan_epoch:
         _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng)
         return
@@ -362,6 +378,209 @@ def _scan_epoch_measure(args, topo, feature, model, tx, labels_all, rng,
     )
     log(trainer.metrics_report())
     write_metrics(trainer, lane="epoch-scan")
+
+
+def _pipeline_measure(args, topo, feature, model, tx, labels_all, rng,
+                      epochs: int = 3):
+    """The software-pipelined epoch vs its serial baselines, all measured
+    in ONE invocation so the scoreboard row carries them side-by-side:
+
+    1. serial stage estimator — eager sample -> gather -> train_step with
+       Timer-fed StepTimeline stages (the stage-sum is what a schedule
+       with NO overlap pays per iteration);
+    2. Prefetcher loop — host-thread double buffering (the pre-pipeline
+       overlap story);
+    3. serial epoch_scan (pipeline_depth=0) — the in-program baseline;
+    4. pipelined epoch_scan (pipeline_depth=1) — the one-step-skew
+       schedule, bitwise-identical math.
+
+    Overlap efficiency = serial stage-sum p50 / pipelined per-step p50
+    (via StepTimeline.overlap_efficiency); > 1.0 means the pipelined step
+    costs less than the sum of its serial stages, i.e. sample/gather
+    latency is actually running under compute. Steady-state recompiles of
+    the pipelined epoch program are counted and must stay 0."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from quiver_tpu import DistributedTrainer, GraphSageSampler, Prefetcher
+    from quiver_tpu.obs import StepTimeline
+    from quiver_tpu.obs.registry import TRAIN_OVERLAP_EFFICIENCY
+    from quiver_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh
+    from quiver_tpu.parallel.train import make_train_step
+    from quiver_tpu.utils.trace import Timer
+
+    n = topo.node_count
+    timeline = StepTimeline()
+
+    # -- 1. serial stage estimator (eager, Timer-synced stages) ---------------
+    sampler_e = GraphSageSampler(
+        topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
+        seed=args.seed, frontier_caps="auto",
+    )
+    step = jax.jit(make_train_step(model, tx))
+    out0 = sampler_e.sample(rng.integers(0, n, args.batch))
+    x0 = feature[out0.n_id]
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, x0, out0.adjs
+    )["params"]
+    opt_state = tx.init(params)
+
+    def iteration(params, opt_state, key):
+        seeds = rng.integers(0, n, args.batch)
+        with Timer("sample", quiet=True, registry=timeline):
+            out = sampler_e.sample(seeds)
+            jax.block_until_ready(out.n_id)
+        with Timer("gather", quiet=True, registry=timeline):
+            x = feature[out.n_id]
+            jax.block_until_ready(x)
+        seed_ids = out.n_id[: args.batch]
+        labels = labels_all[jnp.clip(seed_ids, 0)]
+        mask = seed_ids >= 0
+        with Timer("train_step", quiet=True, registry=timeline):
+            res = step(params, opt_state, x, out.adjs, labels, mask, key)
+            jax.block_until_ready(res[2])
+        return res
+
+    t0 = time.time()
+    for i in range(max(args.warmup, 1)):
+        params, opt_state, loss = iteration(
+            params, opt_state, jax.random.PRNGKey(i)
+        )
+    timeline.clear()  # warmup iterations carry compiles
+    for i in range(args.iters):
+        params, opt_state, loss = iteration(
+            params, opt_state, jax.random.PRNGKey(100 + i)
+        )
+    jax.block_until_ready(loss)
+    serial_ms = {
+        name: timeline.stats(name).quantile(0.5) * 1e3
+        for name in ("sample", "gather", "train_step")
+    }
+    serial_sum_ms = sum(serial_ms.values())
+    log(f"serial stage estimator: {time.time() - t0:.1f}s "
+        f"(stage-sum p50 {serial_sum_ms:.2f} ms/iter)")
+
+    # -- 2. Prefetcher loop (host-thread overlap) -----------------------------
+    depth = max(args.prefetch, 1)
+    seed_stream = [rng.integers(0, n, args.batch) for _ in range(args.iters)]
+    pf = Prefetcher(sampler_e, feature, depth=depth)
+    t0 = time.time()
+    for i, batch in enumerate(pf.run(seed_stream)):
+        seed_ids = batch.out.n_id[: args.batch]
+        labels = labels_all[jnp.clip(seed_ids, 0)]
+        mask = seed_ids >= 0
+        params, opt_state, loss = step(
+            params, opt_state, batch.x, batch.out.adjs, labels, mask,
+            jax.random.PRNGKey(200 + i),
+        )
+    jax.block_until_ready(loss)
+    prefetch_iter_ms = (time.time() - t0) / args.iters * 1e3
+
+    # -- 3+4. serial vs pipelined epoch_scan ----------------------------------
+    mesh = make_mesh()
+    workers = mesh.shape[DATA_AXIS] * (
+        mesh.shape[FEATURE_AXIS] if args.seed_sharding == "all" else 1
+    )
+    local_batch = -(-args.batch // workers)
+    sampler = GraphSageSampler(
+        topo, args.fanout, mode=args.mode, seed_capacity=local_batch,
+        seed=args.seed, frontier_caps="auto",
+    )
+    sampler.sample(rng.integers(0, n, local_batch))
+    train_idx = rng.permutation(n)[: args.train_nodes]
+
+    def scan_epochs(pipeline_depth):
+        trainer = DistributedTrainer(
+            mesh, sampler, feature, model, tx, local_batch=local_batch,
+            seed_sharding=args.seed_sharding, pipeline_depth=pipeline_depth,
+        )
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        t0 = time.time()
+        seed_mat = trainer.pack_epoch(train_idx, key=0)
+        # two warmup epochs: the first compiles against init()'s
+        # uncommitted params, the second against the scan's own sharded
+        # outputs — the steady-state signature. Counting recompiles from
+        # here on, zero is the requirement.
+        for _ in range(2):
+            params, opt_state, losses = trainer.epoch_scan(
+                params, opt_state, seed_mat, labels_all,
+                jax.random.PRNGKey(1),
+            )
+        jax.block_until_ready(losses)
+        steps = int(seed_mat.shape[0])
+        log(f"depth={pipeline_depth} scan warmup+compile: "
+            f"{time.time() - t0:.1f}s ({steps} steps/epoch)")
+        cache_size = getattr(trainer._epoch_fn, "_cache_size", None)
+        c0 = cache_size() if cache_size else None
+        times = []
+        for e in range(epochs):
+            t0 = time.time()
+            seed_mat = trainer.pack_epoch(train_idx, key=e + 1)
+            params, opt_state, losses = trainer.epoch_scan(
+                params, opt_state, seed_mat, labels_all,
+                jax.random.PRNGKey(2 + e),
+            )
+            final_loss = float(losses[-1])  # readback inside the clock
+            times.append(time.time() - t0)
+            if pipeline_depth:
+                timeline.observe("pipelined_step", times[-1] / steps)
+        recompiles = (cache_size() - c0) if cache_size else None
+        return trainer, trimmed_mean(times), steps, final_loss, recompiles
+
+    _, serial_epoch_s, steps, _, _ = scan_epochs(0)
+    trainer, pipe_epoch_s, steps, final_loss, recompiles = scan_epochs(1)
+    pipe_iter_ms = pipe_epoch_s / steps * 1e3
+    eff = timeline.overlap_efficiency(
+        ("sample", "gather", "train_step"), "pipelined_step"
+    )
+    if eff is not None:
+        trainer.metrics.set(TRAIN_OVERLAP_EFFICIENCY, np.float32(eff))
+    scan_speedup = round(serial_epoch_s / pipe_epoch_s, 3)
+    log("stage timeline (serial estimator + pipelined steps):\n"
+        + timeline.report())
+
+    emit(
+        "pipeline-stage-sum", serial_sum_ms, "ms/iter", None,
+        mode="SERIAL-STAGES",
+        sample_ms=round(serial_ms["sample"], 2),
+        gather_ms=round(serial_ms["gather"], 2),
+        train_ms=round(serial_ms["train_step"], 2),
+        batch=args.batch,
+    )
+    emit(
+        "pipeline-prefetch-iter", prefetch_iter_ms, "ms/iter", None,
+        mode="PREFETCH", prefetch=depth, batch=args.batch,
+    )
+    emit(
+        "pipeline-serial-scan-iter", serial_epoch_s / steps * 1e3,
+        "ms/iter", None, mode="FUSED-SCAN", iters_per_epoch=steps,
+        epoch_s=round(serial_epoch_s, 3), batch=args.batch,
+    )
+    emit(
+        "e2e-epoch-time",
+        pipe_epoch_s,
+        "s",
+        BASELINE_EPOCH_S,
+        invert=True,
+        iter_ms=round(pipe_iter_ms, 2),
+        iters_per_epoch=steps,
+        batch=args.batch,
+        model=args.model,
+        mode="FUSED-PIPELINED",
+        topo_mode=args.mode,
+        seed_sharding=args.seed_sharding,
+        bf16=bool(args.bf16),
+        cache_ratio=args.cache_ratio,
+        pipeline_depth=1,
+        overlap_efficiency=(None if eff is None else round(eff, 3)),
+        scan_speedup=scan_speedup,
+        recompiles_steady=recompiles,
+        measured="direct",
+        loss=round(final_loss, 4),
+    )
+    log(trainer.metrics_report())
+    write_metrics(trainer, lane="epoch-pipelined")
 
 
 def _emit_epoch(args, iter_s, loss, fused: bool):

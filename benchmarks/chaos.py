@@ -1,7 +1,7 @@
 """Chaos lane: FaultPlan drills over a tiny epoch — the resilience layer's
 evidence job (mega_session ``chaos`` stage, log-only).
 
-Six deterministic drills, each asserting the property the resilience
+Deterministic drills, each asserting the property the resilience
 layer guarantees (quiver_tpu/resilience/):
 
 * **guard**: a NaN-poisoned batch inside the fused step leaves params
@@ -23,6 +23,12 @@ layer guarantees (quiver_tpu/resilience/):
   failures) trips the circuit breaker into degraded serving — the epoch
   completes with ``resilience.degraded_lookups > 0`` instead of crashing,
   and a half-open probe closes the breaker once the outage ends;
+* **pipeline**: the software-pipelined epoch's crash seam — preempt a
+  ``pipeline_depth=1`` run mid-epoch, resume() (the pipelined chunk
+  re-issues its carried batch from the seed matrix), and the remaining
+  loss trajectory + final params are bit-identical to an UNINTERRUPTED
+  SERIAL (depth=0) run — the pipeline survives kill/replay without ever
+  serializing in-flight batch state;
 * **mutate**: the streaming-mutation drill (quiver_tpu/streaming) — a
   malformed delta batch is quarantined whole at admission (counted,
   never staged), a mid-commit crash (injected at every pre-publish
@@ -47,7 +53,7 @@ import numpy as np
 from benchmarks import common
 
 DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage",
-          "mutate")
+          "pipeline", "mutate")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -65,7 +71,8 @@ def _build_graph(nodes: int, feature_dim: int, seed: int):
 
 
 def _build_trainer(topo, feat, local_batch, plan=None, guard=False,
-                   checkpoint_dir=None, checkpoint_every=0):
+                   checkpoint_dir=None, checkpoint_every=0,
+                   pipeline_depth=0):
     import optax
 
     from quiver_tpu import Feature, GraphSageSampler
@@ -86,7 +93,7 @@ def _build_trainer(topo, feat, local_batch, plan=None, guard=False,
     return DistributedTrainer(
         mesh, sampler, feature, model, optax.sgd(1e-2),
         local_batch=local_batch, nonfinite_guard=guard, fault_plan=plan,
-        **kw
+        pipeline_depth=pipeline_depth, **kw
     )
 
 
@@ -320,6 +327,64 @@ def drill_resize(topo, feat, labels, local_batch, seed):
         f"CHAOS resize OK (killed at step 3 on F={F}, resumed at step "
         f"{step} on F={F // 2}, {losses_r.shape[0]} remaining steps "
         "bit-identical)"
+    )
+
+
+def drill_pipeline(topo, feat, labels, local_batch, seed):
+    """Preempt a pipeline_depth=1 epoch mid-flight, resume, and compare
+    the remaining trajectory + final params bitwise against an
+    UNINTERRUPTED SERIAL (depth=0) run — the crash/replay seam composes
+    with the one-step skew because pipelined chunks re-issue their
+    carried batch from the seed matrix instead of serializing it."""
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import FaultPlan, Preemption
+    from quiver_tpu.obs.registry import PIPELINE_REISSUES
+
+    lab = jnp.asarray(labels)
+    idx = np.random.default_rng(seed).integers(
+        0, topo.node_count, 6 * local_batch * jax.device_count()
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        trainer_a = _build_trainer(topo, feat, local_batch)
+        seed_mat = trainer_a.pack_epoch(idx, seed=0)
+        key = jax.random.PRNGKey(7)
+        pa, oa = trainer_a.init(jax.random.PRNGKey(0))
+        pa, oa, losses_a = trainer_a.epoch_scan(pa, oa, seed_mat, lab, key)
+        losses_a = np.asarray(losses_a)
+
+        trainer_b = _build_trainer(
+            topo, feat, local_batch, checkpoint_dir=f"{tmp}/b",
+            checkpoint_every=2, plan=FaultPlan(preempt_at_step=3),
+            pipeline_depth=1,
+        )
+        p0, o0 = trainer_b.init(jax.random.PRNGKey(0))
+        preempted = False
+        try:
+            trainer_b.epoch_scan(p0, o0, seed_mat, lab, key)
+        except Preemption:
+            preempted = True
+        assert preempted, "FaultPlan preemption never fired"
+        pr, orr, key_r, step, epoch = trainer_b.resume(p0, o0)
+        assert step == 2, f"resumed at step {step}, expected 2"
+        pr, orr, losses_r = trainer_b.epoch_scan(
+            pr, orr, seed_mat, lab, key_r, epoch=epoch, start_step=step
+        )
+        losses_r = np.asarray(losses_r)
+        assert np.array_equal(
+            losses_r.view(np.uint32), losses_a[step:].view(np.uint32)
+        ), "resumed pipelined trajectory diverged from the serial oracle"
+        assert _tree_equal(pa, pr), "resumed pipelined params diverged"
+        reissues = int(np.asarray(
+            trainer_b.metrics.value(PIPELINE_REISSUES)
+        ))
+        assert reissues > 0, "chunked pipelined run never re-issued"
+        trainer_b.checkpointer.close()
+    common.log(
+        f"CHAOS pipeline OK (depth=1 killed at step 3, resumed at {step}, "
+        f"{losses_r.shape[0]} remaining steps bit-identical to the serial "
+        f"run, {reissues} chunk re-issues)"
     )
 
 
@@ -582,6 +647,8 @@ def main():
             drill_cold_outage(
                 topo, feat, labels, args.local_batch, args.seed
             )
+        if "pipeline" in selected:
+            drill_pipeline(topo, feat, labels, args.local_batch, args.seed)
         if "mutate" in selected:
             drill_mutate(topo, feat, args.local_batch, args.seed)
         common.log(f"CHAOS all drills passed ({', '.join(selected)})")

@@ -46,6 +46,13 @@ JOBS = [
      ["--scan-epoch", "--bf16", "--cache-ratio", "1.0"],
      "whole epoch as ONE compiled program, bf16 — the TPU-native epoch "
      "loop, measured directly (vs ref 11.1 s, Introduction_en.md:146-149)"),
+    ("epoch-pipelined", "benchmarks.bench_epoch",
+     ["--pipeline", "--cache-ratio", "1.0"],
+     "software-pipelined epoch (one-step skew: batch t+1's sample+gather "
+     "under batch t's fwd/bwd, bitwise-identical losses) — serial "
+     "stage-sum, Prefetcher, serial-scan, and pipelined rows from ONE "
+     "invocation; overlap_efficiency > 1.0 and recompiles_steady = 0 "
+     "are the acceptance gates"),
     ("sampler-host", "benchmarks.bench_sampler",
      ["--mode", "HOST", "--stream", "128"],
      "ref 34.29M SEPS; ref GPU-over-UVA delta +30-40% (:45)"),
@@ -338,7 +345,10 @@ def write_outputs(results, out, smoke, merge=False):
                                "topo_mode", "cache_ratio", "elected",
                                "model", "prng", "hit_rep", "hit_cold",
                                "effective_lanes_per_hop", "topo_sharding",
-                               "topo_shrink", "comm_reduction")}
+                               "topo_shrink", "comm_reduction",
+                               "overlap_efficiency", "scan_speedup",
+                               "recompiles_steady", "pipeline_depth",
+                               "prefetch")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

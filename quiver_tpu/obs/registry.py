@@ -60,6 +60,8 @@ __all__ = [
     "SERVE_DEADLINE_MISSES",
     "SERVE_DEGRADED_LOOKUPS",
     "SERVE_RECOMPILES",
+    "TRAIN_OVERLAP_EFFICIENCY",
+    "PIPELINE_REISSUES",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -95,6 +97,16 @@ SERVE_REQUESTS = "serve.requests"
 SERVE_DEADLINE_MISSES = "serve.deadline_misses"
 SERVE_DEGRADED_LOOKUPS = "serve.degraded_lookups"
 SERVE_RECOMPILES = "serve.recompiles"
+# software-pipelined epoch (parallel/trainer.py pipeline_depth=1): the
+# derived overlap-efficiency gauge (serial stage-sum over measured
+# pipelined step time, > 1.0 = the schedule is hiding sample/gather
+# latency under compute; fed by bench_epoch --pipeline from the
+# StepTimeline) and the count of prologue batches re-issued at
+# checkpoint-chunk/resume boundaries (the carried batch is replayed from
+# the seed matrix rather than serialized — each boundary costs one extra
+# sample+gather)
+TRAIN_OVERLAP_EFFICIENCY = "train.overlap_efficiency"
+PIPELINE_REISSUES = "train.pipeline_reissues"
 
 _KINDS = ("counter", "gauge")
 
@@ -207,14 +219,34 @@ class MetricsTape:
         self._values[name] = value
         self._note_psum(name, psum)
 
-    def finalize(self) -> dict[str, Any]:
+    def finalize(self, names=None) -> dict[str, Any]:
         """The step's metrics pytree: every registered metric present
         (zero-filled from its spec when unfed — the dict structure must be
-        static across traces), each psum'd ONCE at its declared axes."""
+        static across traces), each psum'd ONCE at its declared axes.
+
+        ``names`` restricts the emitted dict to that subset of registered
+        metrics (still zero-filled when unfed). This is what lets a step
+        built from SPLIT bodies — the pipelined trainer's issue/train
+        halves — emit disjoint dicts whose merge is exactly the fused
+        body's pytree; without the filter each half would zero-fill the
+        other half's metrics and the merge would clobber real values.
+        Feeding a metric and then finalizing without it would silently
+        drop the value, so that raises instead."""
         if not self._registry.enabled:
             return {}
+        if names is None:
+            specs = self._registry.specs()
+        else:
+            specs = {name: self._registry.spec(name) for name in names}
+            dropped = [n for n in self._values if n not in specs]
+            if dropped:
+                raise ValueError(
+                    f"finalize(names=...) would drop fed metrics "
+                    f"{sorted(dropped)}; include them in names or don't "
+                    f"feed them on this tape"
+                )
         out = {}
-        for name, spec in self._registry.specs().items():
+        for name, spec in specs.items():
             v = self._values.get(name)
             if v is None:
                 v = jnp.zeros(spec.shape, spec.dtype)

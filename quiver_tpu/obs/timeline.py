@@ -178,6 +178,35 @@ class StepTimeline:
     def stats(self, name: str) -> StageStats | None:
         return self._stages.get(name)
 
+    def overlap_efficiency(self, serial_stages, measured: str,
+                           q: float = 0.5) -> float | None:
+        """Derived pipeline-attribution metric: the sum of the SERIAL
+        stage quantiles divided by the quantile of the overlapped
+        (measured) step stage — i.e. how much latency the schedule hides.
+        1.0 = no overlap (the pipelined step costs the full stage sum);
+        values above 1.0 mean sample/gather time is running under
+        compute; the upper bound is stage-sum / max-stage (a perfectly
+        hidden pipeline is bounded by its slowest stage).
+
+        ``serial_stages``: stage names timed by a serial estimator (e.g.
+        ``("sample", "gather", "train_step")``); ``measured``: the stage
+        holding per-step times of the overlapped schedule. Returns None
+        when any stage is missing or untimed — a partial sum would
+        silently understate the baseline.
+        """
+        total = 0.0
+        for name in serial_stages:
+            st = self._stages.get(name)
+            v = None if st is None else st.quantile(q)
+            if v is None:
+                return None
+            total += v
+        st = self._stages.get(measured)
+        v = None if st is None else st.quantile(q)
+        if not v:
+            return None
+        return total / v
+
     def summary(self) -> dict[str, StageStats]:
         return dict(self._stages)
 

@@ -36,7 +36,7 @@ import numpy as np
 
 from ..obs.registry import PREFETCH_RETRIES, PREFETCH_SKIPS
 
-__all__ = ["Batch", "Prefetcher"]
+__all__ = ["Batch", "PipelinedBatch", "Prefetcher"]
 
 _SKIP_POLICIES = ("raise", "skip")
 
@@ -47,6 +47,37 @@ class Batch(NamedTuple):
     seeds: object  # the raw seed array this batch was built from
     out: object  # SampleOutput (n_id, batch_size, adjs, ...)
     x: object  # gathered feature rows for out.n_id
+
+
+class PipelinedBatch(NamedTuple):
+    """One fully-materialized sample+gather result carried by the
+    software-pipelined epoch scan (``DistributedTrainer`` with
+    ``pipeline_depth=1``).
+
+    Where :class:`Batch` is the HOST-side container the Prefetcher's
+    worker thread hands to an unfused step, this is the IN-PROGRAM
+    equivalent: the issue half of the fused step produces it, the scan
+    carries it across the one-step skew, and the train half consumes it
+    one step later — all inside one compiled epoch program, so XLA can
+    overlap the next batch's sample/gather collectives with the current
+    batch's forward/backward compute.
+
+    Every array carries a leading per-device block axis (``blocks_per
+    device``; 1 outside elastic mode). ``adjs`` is the sampler's
+    deepest-first Adj tuple with that same leading axis stacked onto the
+    edge_index leaves (the static size/fanout aux describes the
+    UNstacked per-block shape — the train half unstacks before use).
+    ``metrics`` is the issue half's finalized metrics pytree (routed
+    overflow / tier hits / hop overflow, psum'd at their declared axes;
+    ``{}`` when collection is off) so per-step telemetry stays attributed
+    to the batch it measured, not the step that trained it.
+    """
+
+    n_id: object  # (bpd, total_cap) int32 gathered node ids per block
+    x: object  # (bpd, cap, F) gathered feature rows per block
+    adjs: object  # tuple of Adj, edge_index leaves stacked to (bpd, 2, E)
+    num_seeds: object  # (bpd,) int32 valid-seed count per block
+    metrics: object  # issue-half finalized metrics dict ({} when disabled)
 
 
 class _Skipped(NamedTuple):

@@ -42,9 +42,11 @@ from ..feature.shard import ShardedFeature
 from ..obs.registry import (
     GUARD_NONFINITE,
     GUARD_SKIPPED,
+    PIPELINE_REISSUES,
     ROUTED_OVERFLOW,
     SAMPLE_OVERFLOW,
     TIER_HITS,
+    TRAIN_OVERLAP_EFFICIENCY,
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
@@ -53,7 +55,7 @@ from ..resilience.faults import Preemption
 from ..resilience.guard import guard_verdict, guarded_update
 from ..utils.trace import info_once
 from ..parallel.mesh import DATA_AXIS, FEATURE_AXIS, shard_map
-from ..parallel.pipeline import Prefetcher
+from ..parallel.pipeline import PipelinedBatch, Prefetcher
 from ..parallel.train import cross_entropy_on_seeds
 from ..sampling.sampler import Adj, GraphSageSampler, multilayer_sample
 
@@ -132,6 +134,23 @@ class DistributedTrainer:
         ``resume(mesh=)`` continue a run checkpointed at F=8 on an F=4
         mesh bit-identically. None (default) = one block per device with
         the plain pmean reduction (the non-elastic fast path).
+      pipeline_depth: 0 (default) = the serial epoch scan (sample ->
+        gather -> fwd/bwd -> update strictly in order each step); 1 =
+        the software-pipelined epoch schedule: the scan carry becomes
+        (params, opt_state, next_batch) with a ONE-STEP skew — the body
+        trains the carried batch while issuing step t+1's sample+gather,
+        so XLA can overlap the all_to_all / cold-tier gather collectives
+        with the forward/backward compute (a prologue issues batch 0, an
+        epilogue trains the final carried batch). Only the schedule
+        moves: per-step keys stay the pre-split matrix and the two
+        halves compose to the exact serial op sequence, so losses,
+        params, and per-step telemetry are BITWISE identical to depth 0
+        (tests/test_pipelined_epoch.py), including across checkpoint
+        chunks — each chunk re-issues its first batch from the seed
+        matrix (deterministic replay; counted in
+        ``train.pipeline_reissues``) so chunk state never needs to
+        serialize the in-flight batch. Affects epoch_scan only; step()
+        stays the fused serial program.
     """
 
     def __init__(
@@ -153,6 +172,7 @@ class DistributedTrainer:
         checkpoint_every: int = 0,
         checkpoint_keep: int = 3,
         logical_workers: int | None = None,
+        pipeline_depth: int = 0,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -253,6 +273,34 @@ class DistributedTrainer:
                 GUARD_NONFINITE, unit="values",
                 doc="non-finite loss/grad values detected before the "
                     "gradient pmean",
+            )
+        # software-pipelined epoch (pipeline_depth=1): epoch_scan runs the
+        # one-step-skew schedule — train the carried batch while issuing
+        # the next one — built from the same issue/train halves the serial
+        # body composes, so the trajectory stays bitwise identical while
+        # the sample/gather collectives overlap the fwd/bwd compute. The
+        # pipeline telemetry registers only when the schedule exists: a
+        # depth-0 registry is byte-for-byte the pre-pipeline one.
+        self.pipeline_depth = int(pipeline_depth)
+        if self.pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (serial) or 1 (one-step skew), "
+                f"got {pipeline_depth}"
+            )
+        self._pipeline_reissues = 0
+        if self.pipeline_depth:
+            self.metrics.counter(
+                PIPELINE_REISSUES, unit="batches",
+                doc="prologue batches re-issued from the seed matrix at "
+                    "checkpoint-chunk/resume boundaries (the carried "
+                    "batch is replayed, not serialized)",
+            )
+            self.metrics.gauge(
+                TRAIN_OVERLAP_EFFICIENCY, dtype=jnp.float32, unit="x",
+                doc="serial stage-sum over measured pipelined step time "
+                    "(> 1.0 = sample/gather latency hidden under "
+                    "compute; host-derived, see StepTimeline."
+                    "overlap_efficiency)",
             )
         # fault_plan: deterministic chaos schedule (resilience/faults.py).
         # Step indices mean the epoch_scan row (or the eager step() call
@@ -630,12 +678,17 @@ class DistributedTrainer:
         elastic = self.elastic
         bpd = self.blocks_per_device
         workers = self.workers
+        S = self.local_batch  # per-block seed length (static everywhere)
 
-        def one_block(params, topo, parts, seeds, labels, key, inject):
-            # one logical seed block: sample + gather + loss/grad. ``key``
-            # arrives already folded on the block's LOGICAL worker index;
-            # separate streams for sampling vs dropout
-            sample_key, dropout_key = jax.random.split(key)
+        def issue_block(topo, parts, seeds, key):
+            # the SCHEDULE-MOVABLE half of one logical seed block: sample +
+            # three-tier gather. ``key`` arrives already folded on the
+            # block's LOGICAL worker index; the sampling stream is the
+            # first split of it — exactly the stream the fused serial body
+            # always drew — so an issued batch is bitwise the serial one
+            # no matter where in the schedule it runs (the prologue, the
+            # skewed scan body, or a checkpoint-chunk re-issue).
+            sample_key = jax.random.split(key)[0]
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
             if topo_sharded:
                 # sharded-topology sampling: per-hop owner routing over the
@@ -660,11 +713,21 @@ class DistributedTrainer:
                 )
                 sample_ov = jnp.zeros((len(sizes),), jnp.int32)
             x, routed_ov, tier_hits = gather_features(parts, n_id)
+            return n_id, x, adjs, num_seeds, routed_ov, tier_hits, sample_ov
+
+        def train_block(params, n_id, x, adjs, num_seeds, labels, key,
+                        inject):
+            # the COMPUTE half: fault injection, label/mask prep, loss +
+            # grad. Draws the dropout stream — the second split of the
+            # same block key issue_block split its sampling stream from.
+            dropout_key = jax.random.split(key)[1]
             if inject_rows:
                 # FaultPlan NaN injection: poison the leading rows of the
                 # gathered block on planned steps (inject is the per-step
                 # plan flag) — a corrupt batch reaching the loss, which
-                # the non-finite guard below must absorb
+                # the non-finite guard below must absorb. Lives in the
+                # train half so a pipelined carried batch is poisoned at
+                # the same point in the op sequence as the serial body.
                 if not jnp.issubdtype(x.dtype, jnp.inexact):
                     raise ValueError(
                         f"FaultPlan NaN injection needs float features, "
@@ -673,17 +736,40 @@ class DistributedTrainer:
                 rows = min(inject_rows, int(x.shape[0]))
                 poison = jnp.full((rows, x.shape[1]), jnp.nan, x.dtype)
                 x = x.at[:rows].set(jnp.where(inject, poison, x[:rows]))
-            lab = labels[jnp.clip(n_id[: seeds.shape[0]], 0)]
-            mask = jnp.arange(seeds.shape[0]) < num_seeds
+            lab = labels[jnp.clip(n_id[:S], 0)]
+            mask = jnp.arange(S) < num_seeds
 
             def loss_fn(p):
                 logits = model.apply(
-                    {"params": p}, x, adjs, train=True, rngs={"dropout": dropout_key}
+                    {"params": p}, x, adjs, train=True,
+                    rngs={"dropout": dropout_key}
                 )
-                return cross_entropy_on_seeds(logits[: seeds.shape[0]], lab, mask)
+                return cross_entropy_on_seeds(logits[:S], lab, mask)
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return jax.value_and_grad(loss_fn)(params)
+
+        def one_block(params, topo, parts, seeds, labels, key, inject):
+            # one logical seed block = the two halves composed in place
+            # (the serial schedule; pipeline_depth=1 runs the same halves
+            # as separate programs with a one-step skew between them)
+            n_id, x, adjs, num_seeds, routed_ov, tier_hits, sample_ov = (
+                issue_block(topo, parts, seeds, key)
+            )
+            loss, grads = train_block(
+                params, n_id, x, adjs, num_seeds, labels, key, inject
+            )
             return loss, grads, routed_ov, tier_hits, sample_ov
+
+        # the step program's metric names, split by producing half: the
+        # issue half owns the sample/gather telemetry, the train half the
+        # guard counters. The serial body finalizes their union (exactly
+        # the names the fused step always emitted — host-only metrics like
+        # train.pipeline_reissues never enter the program), the pipelined
+        # halves finalize their own subset so the merged per-step dict is
+        # disjoint instead of zero-filled entries clobbering real values.
+        issue_names = (ROUTED_OVERFLOW, TIER_HITS, SAMPLE_OVERFLOW)
+        train_names = (GUARD_SKIPPED, GUARD_NONFINITE) if guard else ()
+        program_names = issue_names + train_names
 
         def body(params, opt_state, topo, parts, seeds, labels, key, inject):
             # distinct key per seed-block worker; under "data" sharding the
@@ -769,7 +855,9 @@ class DistributedTrainer:
             else:
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, tape.finalize()
+            return params, opt_state, loss, tape.finalize(
+                names=program_names
+            )
 
         hot_spec = P(FEATURE_AXIS, None) if sharded else P()
         parts_spec = (P(), hot_spec, P(), P(), P())
@@ -779,7 +867,7 @@ class DistributedTrainer:
         )
         # metric values come out replicated (psum'd at their declared axes)
         metric_specs = (
-            {name: P() for name in metrics.names()}
+            {name: P() for name in program_names}
             if metrics.enabled else {}
         )
         fn = shard_map(
@@ -792,7 +880,146 @@ class DistributedTrainer:
             out_specs=(P(), P(), P(), metric_specs),
             check_vma=False,
         )
-        return jax.jit(fn)
+        step = jax.jit(fn)
+        if not self.pipeline_depth:
+            self._issue = self._train = None
+            return step
+
+        # -- pipeline_depth=1: the two halves as standalone programs -------
+        # Same mesh, same specs, same per-block key folds as the serial
+        # body — only the SCHEDULE differs. The issue program materializes
+        # a PipelinedBatch (per-block arrays stacked on a leading
+        # blocks-per-device axis) plus its finalized sample/gather
+        # telemetry; the train program consumes a carried batch one step
+        # later and emits the guard counters. Composed serially they
+        # reproduce the fused body's op sequence exactly, which is what
+        # makes the pipelined trajectory bitwise identical.
+
+        def issue_body(topo, parts, seeds, key):
+            widx = jax.lax.axis_index(DATA_AXIS)
+            if routed:
+                widx = widx * mesh.shape[FEATURE_AXIS] + jax.lax.axis_index(
+                    FEATURE_AXIS
+                )
+            axes = (DATA_AXIS, FEATURE_AXIS)
+            blocks = seeds.reshape(bpd, -1)
+            outs = [
+                issue_block(
+                    topo, parts, blocks[b],
+                    jax.random.fold_in(key, widx * bpd + b)
+                )
+                for b in range(bpd)
+            ]
+            n_id = jnp.stack([o[0] for o in outs])
+            x = jnp.stack([o[1] for o in outs])
+            # Adj pytrees stack on their edge_index leaves; the static
+            # size/fanout aux keeps describing the per-block shape (the
+            # train half unstacks before the model consumes them)
+            adjs = tuple(jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *[o[2] for o in outs]
+            ))
+            num_seeds = jnp.stack([o[3] for o in outs])
+            routed_ov = sum(o[4] for o in outs)
+            tier_hits = sum(o[5] for o in outs)
+            sample_ov = sum(o[6] for o in outs)
+            # identical feeds (and psum axes) to the serial body — the
+            # issue half owns the batch's telemetry so a carried batch's
+            # metrics stay attributed to the step that SAMPLED it
+            tape = metrics.tape()
+            tape.add(ROUTED_OVERFLOW, routed_ov, psum=DATA_AXIS)
+            tape.set(TIER_HITS, tier_hits,
+                     psum=axes if routed else DATA_AXIS)
+            if topo_sharded:
+                tape.add(SAMPLE_OVERFLOW, sample_ov, psum=DATA_AXIS)
+            return PipelinedBatch(
+                n_id, x, adjs, num_seeds,
+                tape.finalize(names=issue_names),
+            )
+
+        def train_body(params, opt_state, batch, labels, key, inject):
+            widx = jax.lax.axis_index(DATA_AXIS)
+            if routed:
+                widx = widx * mesh.shape[FEATURE_AXIS] + jax.lax.axis_index(
+                    FEATURE_AXIS
+                )
+            axes = (DATA_AXIS, FEATURE_AXIS)
+
+            def block(b):
+                adjs_b = jax.tree_util.tree_map(
+                    lambda leaf: leaf[b], batch.adjs
+                )
+                return train_block(
+                    params, batch.n_id[b], batch.x[b], adjs_b,
+                    batch.num_seeds[b], labels,
+                    jax.random.fold_in(key, widx * bpd + b), inject,
+                )
+
+            # mirror the serial body's reduction exactly: scalar verdict +
+            # plain pmean outside elastic mode, stacked verdict + fixed
+            # logical-worker-order mean inside it
+            if not elastic:
+                loss, grads = block(0)
+                if guard:
+                    ok, local_bad = guard_verdict(loss, grads, axes)
+                grads = jax.lax.pmean(grads, axes)
+                loss = jax.lax.pmean(loss, axes)
+            else:
+                outs = [block(b) for b in range(bpd)]
+                losses = jnp.stack([o[0] for o in outs])
+                grads_blocks = jax.tree_util.tree_map(
+                    lambda *g: jnp.stack(g), *[o[1] for o in outs]
+                )
+                if guard:
+                    ok, local_bad = guard_verdict(losses, grads_blocks, axes)
+                grads = worker_ordered_mean(grads_blocks, axes, workers)
+                loss = worker_ordered_mean(losses, axes, workers)
+            tape = metrics.tape()
+            if guard:
+                tape.add(GUARD_NONFINITE, local_bad,
+                         psum=axes if routed else DATA_AXIS)
+                tape.add(GUARD_SKIPPED, (~ok).astype(jnp.int32))
+                params, opt_state = guarded_update(
+                    tx, grads, opt_state, params, ok
+                )
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, tape.finalize(
+                names=train_names
+            )
+
+        # the batch rides device-resident: every array keeps its producing
+        # worker's shard (the same placement the seed blocks arrive with),
+        # only the finalized metrics are replicated
+        bspec = (
+            P((DATA_AXIS, FEATURE_AXIS)) if routed else P(DATA_AXIS)
+        )
+        batch_spec = PipelinedBatch(
+            n_id=bspec, x=bspec, adjs=bspec, num_seeds=bspec,
+            metrics=(
+                {name: P() for name in issue_names}
+                if metrics.enabled else {}
+            ),
+        )
+        train_metric_specs = (
+            {name: P() for name in train_names}
+            if metrics.enabled else {}
+        )
+        self._issue = jax.jit(shard_map(
+            issue_body,
+            mesh=mesh,
+            in_specs=(topo_spec, parts_spec, self._seed_spec(), P()),
+            out_specs=batch_spec,
+            check_vma=False,
+        ))
+        self._train = jax.jit(shard_map(
+            train_body,
+            mesh=mesh,
+            in_specs=(P(), P(), batch_spec, P(), P(), P()),
+            out_specs=(P(), P(), P(), train_metric_specs),
+            check_vma=False,
+        ))
+        return step
 
     # -- API ----------------------------------------------------------------
 
@@ -930,6 +1157,8 @@ class DistributedTrainer:
         ])
 
     def _build_epoch(self):
+        if self.pipeline_depth:
+            return self._build_epoch_pipelined()
         step = self._step  # jitted shard_map; inlines under the outer jit
 
         # per-step keys arrive PRE-SPLIT (epoch_scan splits key0 eagerly —
@@ -955,6 +1184,68 @@ class DistributedTrainer:
             return p, o, losses, mtrees
 
         return fn  # jit's shape-keyed cache handles distinct step counts
+
+    def _build_epoch_pipelined(self):
+        """The software-pipelined epoch program (pipeline_depth=1).
+
+        One-step skew: the scan carry is (params, opt_state, next_batch)
+        where next_batch is step t's fully-materialized sample+gather
+        result (a :class:`PipelinedBatch`). Iteration t trains the
+        carried batch with step t's key/inject row, then issues step
+        t+1's batch — two halves with NO data dependency between them,
+        so XLA is free to overlap the issue half's all_to_all buckets
+        and cold-tier host gathers with the train half's fwd/bwd
+        compute. A prologue issues batch 0; an epilogue trains the final
+        carried batch; scan's in-place carry aliasing keeps the double
+        buffer allocation-free across iterations.
+
+        Signature-compatible with the serial epoch fn, so epoch_scan's
+        checkpoint chunking applies unchanged: each chunk's prologue
+        re-issues its first batch from the seed matrix (per-step keys
+        are pre-split from key0 over the FULL epoch — deterministic
+        replay, bitwise the batch the previous chunk had in flight).
+        """
+        issue = self._issue
+        train = self._train
+
+        @jax.jit
+        def fn(params, opt_state, topo, parts, seed_mat, labels, keys,
+               inject_vec):
+            first = issue(topo, parts, seed_mat[0], keys[0])
+
+            def body(carry, xs):
+                p, o, batch = carry
+                seeds_next, key_next, key_cur, inj_cur = xs
+                p, o, loss, tmetrics = train(
+                    p, o, batch, labels, key_cur, inj_cur
+                )
+                nxt = issue(topo, parts, seeds_next, key_next)
+                # per-step telemetry = the TRAINED batch's issue metrics
+                # (sampled possibly a chunk ago) + this step's guard
+                # counters — disjoint dicts whose union is exactly the
+                # serial step's metrics pytree
+                return (p, o, nxt), (loss, {**batch.metrics, **tmetrics})
+
+            # xs skewed by one: iteration t consumes step t's key/inject
+            # for the train half and step t+1's seeds/key for the issue
+            # half (length 0 for a single-step chunk — prologue+epilogue
+            # alone cover it)
+            xs = (seed_mat[1:], keys[1:], keys[:-1], inject_vec[:-1])
+            (p, o, last), (losses, mtrees) = jax.lax.scan(
+                body, (params, opt_state, first), xs
+            )
+            p, o, loss_last, tmetrics = train(
+                p, o, last, labels, keys[-1], inject_vec[-1]
+            )
+            losses = jnp.concatenate([losses, loss_last[None]])
+            last_m = {**last.metrics, **tmetrics}
+            mtrees = {
+                name: jnp.concatenate([mtrees[name], last_m[name][None]])
+                for name in last_m
+            }
+            return p, o, losses, mtrees
+
+        return fn
 
     def epoch_scan(self, params, opt_state, seed_mat, labels, key,
                    epoch: int = 0, start_step: int = 0):
@@ -988,6 +1279,15 @@ class DistributedTrainer:
         with ``preempt_at_step`` raises
         :class:`~quiver_tpu.resilience.Preemption` once that step's chunk
         has run but before its checkpoint lands (the drill's "kill").
+
+        With ``pipeline_depth=1`` the same call runs the software-
+        pipelined schedule (one-step skew, see
+        :meth:`_build_epoch_pipelined`): identical signature, identical
+        chunking/resume semantics, bitwise-identical losses, params, and
+        per-step telemetry — each chunk re-issues its first batch from
+        the seed matrix (``train.pipeline_reissues`` counts these), so
+        the carried batch never needs to cross a chunk boundary as
+        state.
         """
         self._check_versions()
         steps = int(np.shape(seed_mat)[0])
@@ -1022,6 +1322,17 @@ class DistributedTrainer:
                     params, opt_state, self.topo, self._feature_parts(),
                     packed[lo:hi], labels, keys[lo:hi], inject_vec[lo:hi]
                 )
+                if self.pipeline_depth and lo > start:
+                    # pipelined chunks after the first re-issue their
+                    # prologue batch (the previous chunk already had it in
+                    # flight) — deterministic replay from the seed matrix
+                    # instead of serializing the carried batch; count the
+                    # overlap the boundary cost
+                    self._pipeline_reissues += 1
+                    self.metrics.set(
+                        PIPELINE_REISSUES,
+                        np.int32(self._pipeline_reissues),
+                    )
                 losses_parts.append(losses)
                 mtrees_parts.append(mtrees)
                 if (plan is not None and not self._preempt_fired

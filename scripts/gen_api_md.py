@@ -36,7 +36,8 @@ SECTIONS = [
      "Capped-bucket owner routing (shared comm core)"),
     ("quiver_tpu.parallel.trainer", "Distributed fused trainer"),
     ("quiver_tpu.parallel.train", "Single-chip train step helpers"),
-    ("quiver_tpu.parallel.pipeline", "Prefetcher"),
+    ("quiver_tpu.parallel.pipeline",
+     "Prefetcher + pipelined-epoch batch container"),
     ("quiver_tpu.resilience",
      "Fault tolerance — non-finite step guard, fault injection"),
     ("quiver_tpu.resilience.elastic",
