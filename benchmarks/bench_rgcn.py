@@ -32,6 +32,38 @@ def main():
     p.add_argument("--fanout", type=int, nargs="+", default=[8, 4])
     p.add_argument("--caps", default="auto", choices=["auto", "worst"])
     p.add_argument(
+        "--topo-sharding",
+        default="replicated",
+        choices=["replicated", "mesh"],
+        dest="topo_sharding",
+        help="relation placement: 'replicated' (every chip holds every "
+        "relation's full CSR) or 'mesh' — each relation partitioned "
+        "across the mesh's feature axis (~1/F topology bytes per chip), "
+        "sampled by DistHeteroSampler through ONE shared BucketRoute "
+        "plan per (hop, destination type); the record carries the exact "
+        "per-edge-type lanes-per-hop comm model + the measured "
+        "per-(hop, edge type) fallback overflow",
+    )
+    p.add_argument(
+        "--routed-alpha",
+        type=float,
+        default=2.0,
+        metavar="A",
+        dest="routed_alpha",
+        help="--topo-sharding mesh: capped-bucket factor — per-destination "
+        "bucket capacity ceil(A*S_t/F) per (hop, dst type); 0 = uncapped "
+        "full-length buckets. Overflow lanes are fallback-served (exact) "
+        "and counted per (hop, edge type)",
+    )
+    p.add_argument(
+        "--weighted",
+        action="store_true",
+        help="attach per-edge weights to every relation and draw "
+        "inverse-CDF weighted samples (mesh lane: the owner searches its "
+        "routed prefix-weight segment; +F*cap f32 lanes per relation "
+        "per hop in the comm model)",
+    )
+    p.add_argument(
         "--stream", type=int, default=0, metavar="N",
         help="also measure N training steps as ONE compiled program "
         "(lax.scan: hetero sample -> tiered gather -> R-GCN fwd/bwd -> "
@@ -85,6 +117,12 @@ def _body(args):
     )
     log(f"hetero graph: {n_paper}+{n_author}+{n_inst} nodes "
         f"({time.time() - t0:.1f}s build)")
+    if args.weighted:
+        wrng = np.random.default_rng(args.seed + 5)
+        for et in topo.relations:
+            topo.set_edge_weight(
+                et, np.exp(wrng.normal(size=topo.relations[et].edge_count))
+            )
 
     feats = {
         t: rng.normal(size=(c, args.feature_dim)).astype(np.float32)
@@ -97,13 +135,19 @@ def _body(args):
         rng.integers(0, args.classes, n_paper).astype(np.int32)
     )
 
-    sampler = HeteroGraphSampler(
-        topo, args.fanout, input_type="paper", seed_capacity=args.batch,
-        frontier_caps="auto" if args.caps == "auto" else None, seed=args.seed,
-    )
     model = RGCN(hidden=args.hidden, num_classes=args.classes,
                  target_type="paper", num_layers=len(args.fanout))
     tx = optax.adam(5e-3)
+
+    if args.topo_sharding == "mesh":
+        return _body_mesh(args, topo, feature, labels_all, model, tx, rng,
+                          n_paper)
+
+    sampler = HeteroGraphSampler(
+        topo, args.fanout, input_type="paper", seed_capacity=args.batch,
+        frontier_caps="auto" if args.caps == "auto" else None,
+        weighted=args.weighted, seed=args.seed,
+    )
 
     out = sampler.sample(rng.integers(0, n_paper, args.batch))
     params = model.init(
@@ -163,6 +207,8 @@ def _body(args):
         batch=args.batch,
         fanout=args.fanout,
         dispatch="percall",
+        topo_sharding="replicated",
+        weighted=args.weighted,
         final_loss=round(float(loss), 4),
     )
 
@@ -247,6 +293,169 @@ def _stream_epoch(args, sampler, feature, labels_all, step, params,
         final_loss=round(results[-1][1], 4),
     )
 
+def _hetero_comm_model(sampler, seed_cap: int) -> dict:
+    """Exact per-device lanes-per-hop model of the mesh-sharded hetero
+    sampler.
+
+    The shared route plan moves each (hop, destination type) frontier's
+    ids ONCE — ``F * cap_t`` lanes, ``cap_t = ceil(alpha * S_t / F)`` —
+    and every relation into that type reuses the cached routed ids. Each
+    uniform relation then adds ``F * cap_t`` (degrees back) +
+    ``2 * F * cap_t * k`` (offsets out, neighbor blocks back); a weighted
+    relation adds one more ``F * cap_t`` f32 exchange (row weight totals
+    back). Bucket shapes are static, so the model is exact; the measured
+    per-(hop, edge type) fallback overflow rides alongside it.
+    """
+    from quiver_tpu.sampling.dist import routed_sample_cap
+
+    F = sampler.workers
+    alpha = sampler.routed_alpha
+    lanes, lanes_unc, hop_caps = [], [], []
+    for active, caps_prev, _ in sampler._plan(seed_cap,
+                                              sampler._cap_overrides):
+        hop, hop_unc, caps_t = 0, 0, {}
+        for t in sorted({et[2] for et in active}):
+            S_t = caps_prev[t]
+            cap_t = routed_sample_cap(S_t, F, alpha) or S_t
+            caps_t[t] = cap_t
+            hop += F * cap_t  # shared plan: ids out once per dst type
+            hop_unc += F * S_t
+        for et, k in sorted(active.items(), key=lambda kv: str(kv[0])):
+            cap_t, S_t = caps_t[et[2]], caps_prev[et[2]]
+            extra = 1 if et in sampler.weighted_rels else 0
+            hop += F * cap_t * (1 + extra + 2 * k)
+            hop_unc += F * S_t * (1 + extra + 2 * k)
+        hop_caps.append(caps_t)
+        lanes.append(hop)
+        lanes_unc.append(hop_unc)
+    plan = sampler.dev_topos.plan
+    return {
+        "topo_sharding": "mesh",
+        "routed_alpha": alpha,
+        "hop_caps": hop_caps,
+        "lanes_per_hop": lanes,
+        "lanes_per_hop_uncapped": lanes_unc,
+        "comm_reduction": round(sum(lanes_unc) / max(sum(lanes), 1), 2),
+        "topo_bytes_per_chip": plan["per_chip_bytes"],
+        "topo_bytes_replicated": plan["replicated_bytes"],
+        "topo_shrink": round(plan["shrink_factor"], 2),
+    }
+
+
+def _body_mesh(args, topo, feature, labels_all, model, tx, rng, n_paper):
+    """--topo-sharding mesh lane: DistHeteroSampler over per-relation
+    mesh partitions. Methodology matches the replicated lane (trimmed-mean
+    iteration time x iterations-per-epoch); each iteration samples every
+    worker's block, runs the R-GCN fwd/bwd per block, and applies the
+    worker-averaged update — the record adds the exact per-edge-type
+    lanes-per-hop comm model and the measured per-(hop, edge type)
+    fallback overflow."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu import DistHeteroSampler
+    from quiver_tpu.parallel.mesh import make_mesh
+
+    if args.stream:
+        log("WARNING: --stream is not supported with --topo-sharding mesh; "
+            "measuring the per-call dispatch loop only")
+    F = len(jax.devices())
+    mesh = make_mesh(data=1, feature=F)
+    sampler = DistHeteroSampler(
+        topo, args.fanout, input_type="paper", mesh=mesh,
+        seed_capacity=-(-args.batch // F),
+        frontier_caps="auto" if args.caps == "auto" else None,
+        weighted=args.weighted, routed_alpha=args.routed_alpha or None,
+        seed=args.seed,
+    )
+    W = sampler.workers
+    cap = -(-args.batch // F)
+
+    def sample_blocks(i):
+        seeds = rng.integers(0, n_paper, args.batch)
+        outs = sampler.sample_per_worker(seeds, key=jax.random.PRNGKey(i))
+        return outs, np.array_split(seeds, W)
+
+    outs, _ = sample_blocks(0)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, feature[outs[0].n_id],
+        outs[0].adjs,
+    )["params"]
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def grad_step(params, x_dict, layers, labels, mask, key):
+        def loss_fn(p):
+            logp = model.apply({"params": p}, x_dict, layers, train=True,
+                               rngs={"dropout": key})
+            ll = jnp.take_along_axis(
+                logp, jnp.clip(labels, 0)[:, None], axis=1
+            )[:, 0]
+            w = mask.astype(logp.dtype)
+            return -(ll * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    @jax.jit
+    def apply_update(params, opt_state, grads):
+        updates, opt_state = tx.update(
+            jax.tree_util.tree_map(lambda g: g / W, grads), opt_state,
+            params
+        )
+        return optax.apply_updates(params, updates), opt_state
+
+    def iteration(params, opt_state, i):
+        outs, _ = sample_blocks(i)
+        grads_acc, loss = None, None
+        for o in outs:
+            seed_ids = o.n_id["paper"][:cap]
+            labels = labels_all[jnp.clip(seed_ids, 0)]
+            loss, grads = grad_step(params, feature[o.n_id], o.adjs,
+                                    labels, seed_ids >= 0,
+                                    jax.random.PRNGKey(i))
+            grads_acc = grads if grads_acc is None else \
+                jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        params, opt_state = apply_update(params, opt_state, grads_acc)
+        return params, opt_state, loss
+
+    t0 = time.time()
+    for i in range(args.warmup):
+        params, opt_state, loss = iteration(params, opt_state, i)
+    jax.block_until_ready(loss)
+    log(f"warmup+compile: {time.time() - t0:.1f}s ({W} workers)")
+
+    times = []
+    for i in range(args.iters):
+        t0 = time.time()
+        params, opt_state, loss = iteration(params, opt_state, 100 + i)
+        jax.block_until_ready(loss)
+        times.append(time.time() - t0)
+
+    iter_s = trimmed_mean(times)
+    train_nodes = n_paper // 10
+    iters_per_epoch = -(-train_nodes // args.batch)
+    model_rec = _hetero_comm_model(sampler, cap)
+    ov = sampler.last_sample_overflow_by_rel or {}
+    emit(
+        "rgcn-epoch-time",
+        iter_s * iters_per_epoch,
+        "s",
+        None,
+        iter_ms=round(iter_s * 1e3, 2),
+        iters_per_epoch=iters_per_epoch,
+        caps=args.caps,
+        batch=args.batch,
+        fanout=args.fanout,
+        dispatch="percall",
+        mesh_devices=W,
+        weighted=args.weighted,
+        sample_overflow={
+            f"hop{li}:{'-'.join(et)}": int(v) for (li, et), v in ov.items()
+        },
+        final_loss=round(float(loss), 4),
+        **model_rec,
+    )
 
 
 if __name__ == "__main__":

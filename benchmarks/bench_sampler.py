@@ -317,20 +317,23 @@ def _sharded_comm_model(sampler, seed_cap: int, caps) -> dict:
     ``(cap, k)`` neighbor blocks back — moving
     ``F * cap_l * (2 + 2 * k_l)`` lanes with capped buckets
     (``cap_l = ceil(alpha * S_l / F)``) vs ``F * S_l * (2 + 2 * k_l)``
-    uncapped. Bucket shapes are static, so the model is exact; the
-    measured fallback overflow rides alongside it in the record.
+    uncapped. A weighted sampler adds one f32 exchange per hop (row
+    weight totals back: ``+F * cap_l`` lanes). Bucket shapes are static,
+    so the model is exact; the measured fallback overflow rides
+    alongside it in the record.
     """
     from quiver_tpu.sampling.dist import routed_sample_cap
 
     F = sampler.topo.num_shards
     alpha = sampler.routed_alpha
+    extra = 1 if sampler.weighted else 0
     widths = (seed_cap,) + tuple(caps[:-1])
     lanes, lanes_unc, hop_caps = [], [], []
     for S_l, k in zip(widths, sampler.sizes):
         cap_l = routed_sample_cap(S_l, F, alpha) or S_l
         hop_caps.append(int(cap_l))
-        lanes.append(F * cap_l * (2 + 2 * k))
-        lanes_unc.append(F * S_l * (2 + 2 * k))
+        lanes.append(F * cap_l * (2 + extra + 2 * k))
+        lanes_unc.append(F * S_l * (2 + extra + 2 * k))
     model = {
         "topo_sharding": "mesh",
         "routed_alpha": alpha,
@@ -359,9 +362,6 @@ def _body_sharded(args):
     from quiver_tpu import GraphSageSampler
     from quiver_tpu.parallel.mesh import make_mesh
 
-    if args.weighted:
-        raise SystemExit("--topo-sharding mesh does not support --weighted "
-                         "(sharded CSR slices carry no weights)")
     if args.kernel != "xla":
         raise SystemExit("--topo-sharding mesh supports --kernel xla only")
     if args.mode not in ("HBM", "GPU"):
@@ -377,12 +377,20 @@ def _body_sharded(args):
             "--topo-sharding mesh measures dedup=sort only")
 
     topo = build_graph(args)
+    if args.weighted:
+        # sharded weighted draws: each shard ships its row-local
+        # prefix-weight segments; the owner answers the inverse-CDF search
+        w = np.exp(
+            np.random.default_rng(args.seed + 5).normal(size=topo.edge_count)
+        ).astype(np.float32)
+        topo.set_edge_weight(w)
     F = len(jax.devices())
     mesh = make_mesh(data=1, feature=F)
     alpha = args.routed_alpha or None
     sampler = GraphSageSampler(
         topo, args.fanout, mode="HBM", seed=args.seed, dedup=dedup,
         topo_sharding="mesh", mesh=mesh, routed_alpha=alpha,
+        weighted=args.weighted,
         frontier_caps="auto" if args.caps == "auto" else None,
     )
     W = sampler.workers
@@ -430,6 +438,7 @@ def _body_sharded(args):
         caps=args.caps,
         dedup=dedup,
         dispatch="percall",
+        weighted=args.weighted,
         mesh_devices=W,
         seps_mesh_total=round(total_edges / dt),
         sample_overflow=sample_overflow,

@@ -135,6 +135,13 @@ JOBS = [
      "frontier routing over capped-bucket all_to_all — lanes-per-hop "
      "model + measured sample_overflow; bit-identical to the replicated "
      "sampler (tests/test_sharded_topology.py)"),
+    ("sampler-hetero-sharded", "benchmarks.bench_rgcn",
+     ["--topo-sharding", "mesh", "--routed-alpha", "2"],
+     "hetero R-GCN epoch over per-relation mesh partitions "
+     "(DistHeteroSampler): ONE shared route plan per (hop, dst type), "
+     "per-edge-type lanes-per-hop model + per-(hop, edge type) "
+     "sample_overflow; bit-identical to the replicated hetero sampler "
+     "(tests/test_dist_hetero.py)"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))

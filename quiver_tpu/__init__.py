@@ -14,6 +14,7 @@ Top-level exports mirror the reference package surface
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
 from .datasets import GraphDataset, load_dataset, planted_partition
 from .core.hetero import HeteroCSRTopo, RelCSR
+from .core.hetero_sharded import HeteroShardedTopology
 from .core.sharded_topology import ShardedTopology
 from .core.topology import CSRTopo, DeviceTopology
 from .feature.feature import Feature, HeteroFeature
@@ -21,7 +22,8 @@ from .feature.shard import ShardedFeature, ShardedTensor
 from .parallel.mesh import MeshTopo, can_device_access_peer, init_p2p, make_mesh
 from .parallel.pipeline import Batch, Prefetcher
 from .parallel.trainer import DataParallelTrainer, DistributedTrainer
-from .sampling.hetero import HeteroGraphSampler, HeteroSampleOutput
+from .sampling.dist_hetero import DistHeteroSampler
+from .sampling.hetero import HeteroGraphSampler, HeteroLayer, HeteroSampleOutput
 from .sampling.saint import (
     SAINTEdgeSampler,
     SAINTNodeSampler,
@@ -69,11 +71,14 @@ __all__ = [
     "CSRTopo",
     "DeviceTopology",
     "ShardedTopology",
+    "HeteroShardedTopology",
     "DistGraphSageSampler",
+    "DistHeteroSampler",
     "HeteroCSRTopo",
     "RelCSR",
     "GraphSageSampler",
     "HeteroGraphSampler",
+    "HeteroLayer",
     "HeteroSampleOutput",
     "SAINTNodeSampler",
     "SAINTEdgeSampler",

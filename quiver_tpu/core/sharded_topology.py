@@ -55,20 +55,40 @@ class ShardedTopology:
       mesh: the device mesh; the partition runs over ``mesh.shape[axis]``
         shards (and is replicated across the other axes, so every data
         group holds one full copy of the partition — not of the graph).
-      csr_topo: host CSR to partition. Edge weights / eid are not carried
-        (weighted and with_eid sampling stay on the replicated sampler).
+      csr_topo: host CSR to partition. ``eid`` is not carried (with_eid
+        sampling stays on the replicated sampler).
       axis: mesh axis name to shard over (default ``"feature"`` — the same
         axis the sharded feature table lives on, so one owner-routing
         budget covers both).
+      with_weights: also ship each shard's slice of the row-local
+        prefix-weight array (``CSRTopo.cum_weights``) for weighted
+        distributed draws. The prefixes are ROW-local, so a shard's slice
+        is bitwise identical to the replicated array's segment — the
+        weighted bit-parity source.
+      with_times: also ship each shard's slice of the CSR-ordered
+        timestamp array (``CSRTopo.edge_time``) for temporal windows.
     """
 
-    def __init__(self, mesh, csr_topo: CSRTopo, axis: str = FEATURE_AXIS):
+    def __init__(self, mesh, csr_topo: CSRTopo, axis: str = FEATURE_AXIS,
+                 with_weights: bool = False, with_times: bool = False):
         self.mesh = mesh
         self.axis = axis
         # retained for replan(): an elastic resume re-partitions the SAME
         # host CSR onto a differently-shaped mesh (the arrays are already
         # host-resident on the CSRTopo — this is a reference, not a copy)
         self.csr_topo = csr_topo
+        self.with_weights = bool(with_weights)
+        self.with_times = bool(with_times)
+        if self.with_weights and csr_topo.cum_weights is None:
+            raise ValueError(
+                "with_weights=True requires edge weights; call "
+                "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
+            )
+        if self.with_times and csr_topo.edge_time is None:
+            raise ValueError(
+                "with_times=True requires edge timestamps; call "
+                "csr_topo.set_edge_time() or pass edge_time= to CSRTopo"
+            )
         F = int(mesh.shape[axis])
         indptr = np.asarray(csr_topo.indptr, dtype=np.int64)
         indices = np.asarray(csr_topo.indices)
@@ -93,9 +113,37 @@ class ShardedTopology:
             lo_e = int(indptr[min(d * rps, n)])
             ix[d, : shard_edges[d]] = indices[lo_e : lo_e + shard_edges[d]]
 
+        def _edge_attr_slices(attr):
+            # same per-shard edge ranges as indices; zero-padded to E_pad.
+            # np slicing copies bytes verbatim, so each shard's slice is
+            # bitwise identical to the replicated array's segment
+            out = np.zeros((F, E_pad), dtype=attr.dtype)
+            for d in range(F):
+                lo_e = int(indptr[min(d * rps, n)])
+                out[d, : shard_edges[d]] = attr[lo_e : lo_e + shard_edges[d]]
+            return out
+
         sharding = NamedSharding(mesh, P(axis, None))
         self.indptr = jax.device_put(ip, sharding)
         self.indices = jax.device_put(ix, sharding)
+        self.cum_weights = None
+        self.edge_time = None
+        attr_bytes = 0
+        if self.with_weights:
+            cw = _edge_attr_slices(np.asarray(csr_topo.cum_weights))
+            self.cum_weights = jax.device_put(cw, sharding)
+            attr_bytes += E_pad * cw.dtype.itemsize
+        if self.with_times:
+            et = _edge_attr_slices(np.asarray(csr_topo.edge_time))
+            self.edge_time = jax.device_put(et, sharding)
+            attr_bytes += E_pad * et.dtype.itemsize
+        # static binary-search bound for the weighted/temporal draws, from
+        # the GLOBAL max degree so every shard compiles the same loop
+        self.search_iters = (
+            max(int(np.ceil(np.log2(csr_topo.max_degree + 1))), 1)
+            if (self.with_weights or self.with_times)
+            else 0
+        )
         self.node_count = n
         self.edge_count = E
         self.max_degree = int(csr_topo.max_degree)
@@ -109,10 +157,15 @@ class ShardedTopology:
         # the partition plan — per-chip byte accounting the acceptance
         # criteria assert on (padded_edges is the widest shard, so skewed
         # row ranges show up here as a shrink factor below F)
-        per_chip = (rps + 1) * ip.dtype.itemsize + E_pad * ix.dtype.itemsize
+        per_chip = (
+            (rps + 1) * ip.dtype.itemsize + E_pad * ix.dtype.itemsize
+            + attr_bytes
+        )
         replicated = (
             (n + 1) * csr_topo.indptr.dtype.itemsize
             + E * indices.dtype.itemsize
+            + (E * 4 if self.with_weights else 0)
+            + (E * 4 if self.with_times else 0)
         )
         self.plan = {
             "num_shards": F,
@@ -141,7 +194,8 @@ class ShardedTopology:
         results stay bit-identical (the PR 3 parity contract: routing
         decides which wires the bits cross, never the bits)."""
         return ShardedTopology(
-            mesh, self.csr_topo, axis=self.axis if axis is None else axis
+            mesh, self.csr_topo, axis=self.axis if axis is None else axis,
+            with_weights=self.with_weights, with_times=self.with_times,
         )
 
     def owner_of(self, ids):

@@ -106,6 +106,19 @@ def _row_prefix_weights(w: np.ndarray, indptr: np.ndarray) -> np.ndarray:
     return prefix.astype(np.float32)
 
 
+def _time_sort_order(indptr: np.ndarray, times: np.ndarray) -> np.ndarray:
+    """Permutation that stably sorts each CSR row's edges by timestamp.
+
+    The temporal sampler binary-searches a ``[lo, hi]`` window per row, so
+    rows must be time-nondecreasing; stability keeps the original CSR slot
+    order as the tiebreak, which is what makes independently built
+    replicated and sharded placements bitwise identical."""
+    deg = np.diff(indptr).astype(np.int64)
+    rows = np.repeat(np.arange(deg.shape[0], dtype=np.int64), deg)
+    # lexsort: last key (rows) is primary, stable on equal (row, time) pairs
+    return np.lexsort((times, rows))
+
+
 class CSRTopo:
     """CSR graph topology with degree and feature-order bookkeeping.
 
@@ -115,7 +128,7 @@ class CSRTopo:
     """
 
     def __init__(self, edge_index=None, indptr=None, indices=None, eid=None,
-                 edge_weight=None, use_native: bool = True):
+                 edge_weight=None, edge_time=None, use_native: bool = True):
         if edge_index is not None:
             if indptr is not None or indices is not None:
                 raise ValueError("pass either edge_index or indptr/indices, not both")
@@ -171,6 +184,7 @@ class CSRTopo:
         self._feature_order = None  # set by Feature's degree reorder
         self._edge_weight = None
         self._cum_weights = None
+        self._edge_time = None
         # streaming-mutation version: bumped ONCE per committed transaction
         # (quiver_tpu.streaming); device placements capture the version they
         # were built from and raise VersionMismatchError instead of serving
@@ -178,6 +192,8 @@ class CSRTopo:
         self._version = 0
         if edge_weight is not None:
             self.set_edge_weight(edge_weight, coo_order=edge_index is not None)
+        if edge_time is not None:
+            self.set_edge_time(edge_time, coo_order=edge_index is not None)
 
     # -- properties (parity with reference utils.py:150-210) ---------------
 
@@ -246,6 +262,50 @@ class CSRTopo:
         prefix 1..deg."""
         return self._cum_weights
 
+    # -- edge timestamps (temporal sampling) ---------------------------------
+
+    def set_edge_time(self, edge_time, coo_order: bool = True) -> "CSRTopo":
+        """Attach per-edge timestamps for temporal (time-windowed) sampling.
+
+        Each row's edges are stably re-sorted time-nondecreasing (``eid``
+        and ``edge_weight`` follow the permutation; the weight prefix sums
+        re-derive), so the sampler can binary-search a ``[lo, hi]`` window
+        to a contiguous slot range per row. The re-sort changes CSR slot
+        order — attach timestamps BEFORE building samplers or device
+        placements. ``coo_order=True`` means timestamps align with the COO
+        edge order this topology was built from (translated through
+        ``eid``); otherwise they are taken in CSR slot order.
+        """
+        t = _as_numpy(edge_time).astype(np.float64, copy=False).reshape(-1)
+        if t.shape[0] != self.edge_count:
+            raise ValueError(
+                f"edge_time must have {self.edge_count} entries, got {t.shape[0]}"
+            )
+        if t.size and not np.isfinite(t).all():
+            # NaN compares false everywhere and would silently empty or
+            # corrupt every window search
+            raise ValueError("edge times must be finite")
+        if coo_order and self._eid is not None:
+            t = t[self._eid]
+        t = t.astype(np.float32)
+        order = _time_sort_order(self._indptr, t)
+        self._indices = self._indices[order]
+        self._edge_time = t[order]
+        if self._eid is not None:
+            self._eid = self._eid[order]
+        if self._edge_weight is not None:
+            self._edge_weight = self._edge_weight[order]
+            self._cum_weights = _row_prefix_weights(
+                self._edge_weight, self._indptr
+            )
+        return self
+
+    @property
+    def edge_time(self) -> np.ndarray | None:
+        """Per-edge timestamps in CSR slot order (float32, rows sorted
+        time-nondecreasing), or None if untimestamped."""
+        return self._edge_time
+
     @property
     def version(self) -> int:
         """Committed mutation version (0 for a freshly built topology;
@@ -253,29 +313,58 @@ class CSRTopo:
         compare their placed version against this to detect staleness."""
         return self._version
 
-    def _publish_mutation(self, indptr: np.ndarray,
-                          indices: np.ndarray) -> None:
+    def _publish_mutation(self, indptr: np.ndarray, indices: np.ndarray,
+                          edge_weight: np.ndarray | None = None,
+                          edge_time: np.ndarray | None = None) -> None:
         """Streaming-commit publish seam (``quiver_tpu.streaming`` only):
         swap in the merged, already-VERIFIED CSR arrays and bump the
         version — the single publication point of an atomic commit. Every
         array is built and checked aside before this runs; the method body
-        is a handful of reference assignments, so there is no window in
-        which a reader can observe a half-applied merge. ``eid`` is
-        dropped (COO provenance does not survive mutation);
-        ``feature_order`` is kept (the node id space is invariant —
-        streaming deltas never add or remove nodes); weighted topologies
-        are rejected upstream by the streaming layer."""
-        if self._edge_weight is not None:
+        is pure reference assignment plus per-row derived-array rebuilds on
+        arrays no reader holds yet, so there is no window in which a reader
+        can observe a half-applied merge. ``eid`` is dropped (COO
+        provenance does not survive mutation); ``feature_order`` is kept
+        (the node id space is invariant — streaming deltas never add or
+        remove nodes). A weighted/timestamped topology must be published
+        with matching merged attribute arrays (the streaming admission
+        layer guarantees this by rejecting attribute-less deltas);
+        timestamped rows are re-sorted time-nondecreasing, restoring the
+        sampler's binary-search invariant after appends."""
+        if (self._edge_weight is not None) != (edge_weight is not None):
             raise ValueError(
-                "cannot publish a mutation onto a weighted topology "
-                "(the streaming layer rejects these at construction)"
+                "mutation publish must carry edge weights exactly when the "
+                "topology is weighted (the streaming admission layer "
+                "rejects mismatched deltas)"
+            )
+        if (self._edge_time is not None) != (edge_time is not None):
+            raise ValueError(
+                "mutation publish must carry edge times exactly when the "
+                "topology is timestamped (the streaming admission layer "
+                "rejects mismatched deltas)"
             )
         edge_count = int(indptr[-1])
         node_count = int(indptr.shape[0] - 1)
-        self._indptr = indptr.astype(_index_dtype(edge_count), copy=False)
-        self._indices = indices.astype(
+        indptr = indptr.astype(_index_dtype(edge_count), copy=False)
+        indices = indices.astype(
             _index_dtype(max(node_count - 1, 0)), copy=False
         )
+        if edge_time is not None:
+            t = edge_time.astype(np.float32, copy=False)
+            # appended inserts land at row ends in ingestion order; re-sort
+            # each row time-nondecreasing (identity on untouched rows)
+            order = _time_sort_order(indptr, t)
+            indices = indices[order]
+            t = t[order]
+            if edge_weight is not None:
+                edge_weight = edge_weight[order]
+            self._edge_time = t
+        if edge_weight is not None:
+            self._edge_weight = edge_weight.astype(np.float32, copy=False)
+            self._cum_weights = _row_prefix_weights(
+                self._edge_weight.astype(np.float64), indptr
+            )
+        self._indptr = indptr
+        self._indices = indices
         self._eid = None
         self._version += 1
 
@@ -311,7 +400,7 @@ class CSRTopo:
         ``os.replace`` renames them into place — a crash mid-save can
         leave a stale temp file but never a torn topology at ``path``."""
         arrays = {"indptr": self._indptr, "indices": self._indices}
-        for name in ("eid", "edge_weight", "feature_order"):
+        for name in ("eid", "edge_weight", "edge_time", "feature_order"):
             v = getattr(self, f"_{name}")
             if v is not None:
                 arrays[name] = v
@@ -367,6 +456,9 @@ class CSRTopo:
                 ) from None
             if "edge_weight" in z.files:
                 topo.set_edge_weight(z["edge_weight"], coo_order=False)
+            if "edge_time" in z.files:
+                # stored post-sort, so the re-sort inside is the identity
+                topo.set_edge_time(z["edge_time"], coo_order=False)
             if "feature_order" in z.files:
                 topo.feature_order = z["feature_order"]
         return topo
@@ -374,7 +466,8 @@ class CSRTopo:
     # -- device placement ---------------------------------------------------
 
     def to_device(self, mode: SampleMode | str = SampleMode.HBM,
-                  with_eid: bool = False, with_weights: bool = False) -> "DeviceTopology":
+                  with_eid: bool = False, with_weights: bool = False,
+                  with_times: bool = False) -> "DeviceTopology":
         """Place the topology for sampling.
 
         HBM mode puts everything in device memory. HOST mode keeps the large
@@ -382,30 +475,48 @@ class CSRTopo:
         where supported — on platforms without a pinned_host memory space it
         degrades to HBM with a warning-free fallback (CPU tests take this
         path). ``with_weights`` ships the prefix-weight array for weighted
-        sampling (requires ``set_edge_weight`` first).
+        sampling (requires ``set_edge_weight`` first); ``with_times`` ships
+        the timestamp array for temporal windows (requires ``set_edge_time``
+        first, HBM mode only — the window search gathers timestamps inside
+        the draw loop, which HOST staging cannot serve).
         """
         if with_weights and self._cum_weights is None:
             raise ValueError(
                 "weighted sampling requires edge weights; call "
                 "set_edge_weight() or pass edge_weight= to CSRTopo"
             )
+        if with_times:
+            if self._edge_time is None:
+                raise ValueError(
+                    "temporal sampling requires edge timestamps; call "
+                    "set_edge_time() or pass edge_time= to CSRTopo"
+                )
+            if SampleMode.parse(mode) is not SampleMode.HBM:
+                raise ValueError(
+                    "temporal sampling requires mode='HBM' — the window "
+                    "search gathers timestamps inside the draw loop, which "
+                    "HOST-staged placement cannot serve"
+                )
         return place_csr_arrays(
             self._indptr, self._indices,
             self._eid if with_eid else None,
             self._cum_weights if with_weights else None,
             self.max_degree, mode,
+            edge_time=self._edge_time if with_times else None,
         )
 
 
 def place_csr_arrays(indptr, indices, eid, cum_weights, max_degree: int,
-                     mode: SampleMode | str) -> "DeviceTopology":
+                     mode: SampleMode | str,
+                     edge_time=None) -> "DeviceTopology":
     """Shared CSR placement for CSRTopo and hetero RelCSR.
 
     HBM mode puts everything in device memory; HOST mode keeps the large
     per-edge arrays (indices/eid/cum_weights) in pinned host memory where
-    supported. Pass ``eid``/``cum_weights`` as None to omit them; the
-    weighted binary search's static iteration bound derives from
-    ``max_degree``.
+    supported. Pass ``eid``/``cum_weights``/``edge_time`` as None to omit
+    them (``edge_time`` is HBM-only, enforced by the ``to_device`` callers);
+    the weighted/temporal binary searches' static iteration bound derives
+    from ``max_degree``.
     """
     mode = SampleMode.parse(mode)
     indptr = jnp.asarray(indptr)
@@ -425,14 +536,16 @@ def place_csr_arrays(indptr, indices, eid, cum_weights, max_degree: int,
             eid = jnp.asarray(eid)
         if cum_weights is not None:
             cum_weights = jnp.asarray(cum_weights)
+    if edge_time is not None:
+        edge_time = jnp.asarray(edge_time)
     iters = (
         max(int(np.ceil(np.log2(max_degree + 1))), 1)
-        if cum_weights is not None
+        if cum_weights is not None or edge_time is not None
         else 0
     )
     return DeviceTopology(indptr=indptr, indices=indices, eid=eid,
-                          cum_weights=cum_weights, host_indices=host,
-                          search_iters=iters)
+                          cum_weights=cum_weights, edge_time=edge_time,
+                          host_indices=host, search_iters=iters)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -444,11 +557,13 @@ class DeviceTopology:
     """
 
     def __init__(self, indptr, indices, eid=None, cum_weights=None,
-                 host_indices: bool = False, search_iters: int = 0):
+                 edge_time=None, host_indices: bool = False,
+                 search_iters: int = 0):
         self.indptr = indptr
         self.indices = indices
         self.eid = eid
         self.cum_weights = cum_weights
+        self.edge_time = edge_time
         self.host_indices = host_indices
         self.search_iters = search_iters
 
@@ -461,11 +576,12 @@ class DeviceTopology:
         return self.indices.shape[0]
 
     def tree_flatten(self):
-        children = (self.indptr, self.indices, self.eid, self.cum_weights)
+        children = (self.indptr, self.indices, self.eid, self.cum_weights,
+                    self.edge_time)
         return children, (self.host_indices, self.search_iters)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        indptr, indices, eid, cum_weights = children
-        return cls(indptr, indices, eid, cum_weights,
+        indptr, indices, eid, cum_weights, edge_time = children
+        return cls(indptr, indices, eid, cum_weights, edge_time,
                    host_indices=aux[0], search_iters=aux[1])

@@ -48,6 +48,7 @@ __all__ = [
     "ROUTED_OVERFLOW",
     "TIER_HITS",
     "SAMPLE_OVERFLOW",
+    "HETERO_SAMPLE_OVERFLOW",
     "GUARD_SKIPPED",
     "GUARD_NONFINITE",
     "PREFETCH_RETRIES",
@@ -70,6 +71,11 @@ __all__ = [
 ROUTED_OVERFLOW = "feature.routed_overflow"
 TIER_HITS = "feature.tier_hits"
 SAMPLE_OVERFLOW = "sample.hop_overflow"
+# per-(hop, edge-type) routed-overflow lanes of the distributed hetero
+# sampler (flat vector in the sampler's static slot order; relations
+# sharing a destination type share that hop's route plan, so they report
+# the plan's overflow equally)
+HETERO_SAMPLE_OVERFLOW = "sample.hetero_hop_overflow"
 # resilience layer: steps cond-skipped by the non-finite guard, and the
 # mesh-total count of non-finite loss/grad values it detected
 GUARD_SKIPPED = "resilience.skipped_steps"

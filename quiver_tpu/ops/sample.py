@@ -28,7 +28,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_layer", "stratified_offsets", "weighted_offsets", "staged_gather"]
+__all__ = [
+    "sample_layer",
+    "stratified_offsets",
+    "temporal_window_counts",
+    "weighted_offsets",
+    "staged_gather",
+]
 
 
 def stratified_offsets(key, deg, k: int):
@@ -147,8 +153,43 @@ def weighted_offsets(key, cum_weights, base, deg, k: int, iters: int,
     return off, sel_mask
 
 
+def temporal_window_counts(edge_time, base, deg, lo_t, hi_t, iters: int):
+    """Per-row slot range of edges whose timestamp falls in ``[lo_t, hi_t]``.
+
+    Requires rows time-sorted (``CSRTopo.set_edge_time``). Two vectorized
+    binary searches over each row's ``deg + 1`` candidate split points:
+    ``first`` counts edges with ``t < lo_t``; the window's masked degree
+    ``deg_t`` counts edges with ``lo_t <= t <= hi_t``, so the in-window
+    edges occupy row-local slots ``[first, first + deg_t)``. ``iters`` >=
+    ceil(log2(max_degree + 1)) guarantees convergence (converged lanes are
+    frozen arithmetically, so extra iterations are no-ops). Returns
+    ``(first, deg_t)``, both (S,) int32.
+    """
+    degc = deg.astype(base.dtype)
+    zero = jnp.zeros_like(degc)
+    probe_cap = jnp.maximum(degc - 1, 0)
+
+    def count(cmp):
+        lo = zero
+        hi = degc
+        for _ in range(iters):
+            active = lo < hi
+            mid = (lo + hi) // 2
+            # clamp the probe into the row; inactive/empty lanes read a
+            # garbage-but-in-range slot and are masked out of the update
+            tv = edge_time[base + jnp.minimum(mid, probe_cap)]
+            go = cmp(tv) & active
+            lo = jnp.where(go, mid + 1, lo)
+            hi = jnp.where(go | ~active, hi, mid)
+        return lo
+
+    first = count(lambda t: t < lo_t)
+    below_hi = count(lambda t: t <= hi_t)
+    return first.astype(jnp.int32), (below_hi - first).astype(jnp.int32)
+
+
 def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False,
-                 weighted: bool = False):
+                 weighted: bool = False, time_window=None):
     """Sample up to ``k`` neighbors for each valid seed.
 
     Args:
@@ -159,6 +200,10 @@ def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False,
          the reference's fanout -1, sage_sampler.py:67).
       key: PRNG key.
       with_eid: also return global CSR edge positions per sample.
+      time_window: optional ``(lo, hi)`` scalar timestamps; only edges with
+        ``lo <= t <= hi`` are drawn from (masked degrees — expired edges
+        never appear). Requires a time-sorted topology placed with
+        ``to_device(with_times=True)``; mutually exclusive with weighted.
 
     Returns:
       neighbors: (S, K) sampled node ids, -1 where invalid.
@@ -179,6 +224,24 @@ def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False,
     deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
     deg = jnp.where(valid, deg, 0)
 
+    first = None
+    if time_window is not None:
+        if weighted:
+            raise ValueError(
+                "time_window cannot be combined with weighted=True; pick "
+                "one biased draw per sampler"
+            )
+        if topo.edge_time is None:
+            raise ValueError(
+                "temporal sampling needs topo.edge_time; build the "
+                "DeviceTopology with to_device(with_times=True)"
+            )
+        lo_t, hi_t = time_window
+        first, deg = temporal_window_counts(
+            topo.edge_time, base, deg, lo_t, hi_t, topo.search_iters
+        )
+        deg = jnp.where(valid, deg, 0)
+
     if weighted:
         if topo.cum_weights is None:
             raise ValueError(
@@ -193,6 +256,10 @@ def sample_layer(topo, seeds, num_seeds, k: int, key, with_eid: bool = False,
         kj, kr = jax.random.split(key)
         off_nr, mask_sel = stratified_offsets(kj, deg, k)
         off = rotate_offsets(kr, off_nr, deg, k)
+    if first is not None:
+        # window offsets are row-local within [first, first + deg_t);
+        # rebase them onto the full row before the CSR gather
+        off = first[:, None] + off
     mask = valid[:, None] & mask_sel
 
     epos = base[:, None] + off.astype(base.dtype)

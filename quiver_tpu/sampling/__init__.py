@@ -1,0 +1,55 @@
+"""Neighbor samplers: the package's public sampling surface.
+
+One family, four members, one output contract:
+
+* :class:`GraphSageSampler` — replicated-topology k-hop sampler
+  (uniform, weighted, or temporal time-windowed draws; ``xla`` or
+  ``pallas`` kernels).
+* :class:`DistGraphSageSampler` — the same sampler over a mesh-sharded
+  topology (``core.sharded_topology.ShardedTopology``): owner-routed
+  hops, bit-identical per worker to the replicated sampler.
+* :class:`HeteroGraphSampler` — typed (heterogeneous) relations over a
+  ``HeteroCSRTopo``; per-relation fanouts and per-type frontiers.
+* :class:`DistHeteroSampler` — the typed sampler over per-relation
+  mesh partitions (``core.hetero_sharded.HeteroShardedTopology``), one
+  shared route plan per (hop, destination type).
+
+Plus the graph-sampling alternatives (:class:`SAINTNodeSampler` et al.)
+and the shared output records (:class:`Adj`, :class:`SampleOutput`,
+:class:`HeteroLayer`, :class:`HeteroSampleOutput`).
+"""
+
+from .dist import (
+    DistGraphSageSampler,
+    dist_multilayer_sample,
+    dist_sample_layer,
+    routed_sample_cap,
+)
+from .dist_hetero import DistHeteroSampler, dist_hetero_multilayer_sample
+from .hetero import HeteroGraphSampler, HeteroLayer, HeteroSampleOutput
+from .saint import (
+    SAINTEdgeSampler,
+    SAINTNodeSampler,
+    SAINTRandomWalkSampler,
+    saint_subgraph,
+)
+from .sampler import Adj, GraphSageSampler, SampleOutput
+
+__all__ = [
+    "Adj",
+    "SampleOutput",
+    "GraphSageSampler",
+    "DistGraphSageSampler",
+    "HeteroLayer",
+    "HeteroSampleOutput",
+    "HeteroGraphSampler",
+    "DistHeteroSampler",
+    "SAINTNodeSampler",
+    "SAINTEdgeSampler",
+    "SAINTRandomWalkSampler",
+    "saint_subgraph",
+    "dist_sample_layer",
+    "dist_multilayer_sample",
+    "dist_hetero_multilayer_sample",
+    "routed_sample_cap",
+]

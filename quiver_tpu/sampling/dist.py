@@ -31,6 +31,16 @@ Comm model (L = per-device frontier width, F = shards, k = fanout,
 id lanes of the second exchange are not re-sent (the route plan caches
 them).
 
+Weighted and temporal draws ride the SAME route plan. The weighted hop
+adds one f32 exchange (per-row total weight back) and moves the
+inverse-CDF binary search to the owner, which searches its routed
+prefix-weight segment — bitwise identical f32 values to the replicated
+array's row, so the draw is bit-identical too (+``F*cap`` f32 lanes; the
+offsets-out hop carries the (S, k) f32 uniform block instead of int32
+offsets). The temporal hop answers ``(first, deg_t)`` in-window slot
+ranges in place of plain degrees (one int32 exchange with trailing dim
+2, +``F*cap`` lanes over uniform).
+
 Bit-parity contract: for the same seed block, PRNG key, fanouts, frontier
 caps, and dedup strategy, every per-worker ``SampleOutput`` (n_id, adjs)
 is bit-identical to the replicated ``GraphSageSampler``'s on that block
@@ -92,7 +102,10 @@ def _worker_index(mesh):
 
 def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
                       seeds, num_seeds, k: int, key, *, axis: str,
-                      num_shards: int, cap: int | None):
+                      num_shards: int, cap: int | None,
+                      weighted: bool = False, local_cum_weights=None,
+                      time_window=None, local_edge_time=None,
+                      search_iters: int = 0, route=None):
     """One distributed hop (per-device body; call inside ``shard_map``).
 
     Args:
@@ -102,19 +115,39 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
       num_seeds: scalar count of valid seeds.
       k: static fanout.
       key: PRNG key — consumed exactly like the replicated
-        ``sample_layer`` (split into jitter/rotation streams over the same
-        (S, k) shapes), which is what makes results bit-identical.
+        ``sample_layer`` (same splits over the same (S, k) shapes; the
+        weighted draw consumes it unsplit, also matching), which is what
+        makes results bit-identical.
       axis / num_shards: the mesh axis the topology is sharded over.
       cap: per-destination routed-bucket capacity (None = uncapped).
+      weighted: inverse-CDF weighted draw against the owner's routed
+        prefix-weight segments; requires ``local_cum_weights`` (this
+        shard's (padded_edges,) slice of ``CSRTopo.cum_weights``).
+      time_window: optional ``(lo, hi)`` scalar timestamps; the owner
+        binary-searches each routed row's in-window slot range and the
+        requester draws within it (masked degrees). Requires
+        ``local_edge_time``; mutually exclusive with ``weighted``.
+      search_iters: static binary-search bound for the weighted/temporal
+        paths — MUST derive from the GLOBAL max degree so every shard
+        (and the replicated oracle) runs the same loop.
+      route: an existing ``BucketRoute`` built over this hop's ``seeds``
+        (the hetero sampler shares ONE route per destination type across
+        every relation into it — the plan's id lanes are sent once and
+        cached). ``None`` builds a fresh route.
 
     Returns (neighbors (S, k) int32 -1-masked, counts (S,), overflow
     scalar — the axis-group total of fallback-served lanes).
     """
+    from ..ops.sample import _cdf_search, temporal_window_counts
+
     S = seeds.shape[0]
     valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
     s = jnp.where(valid, seeds, 0)
     my = jax.lax.axis_index(axis)
     E_local = local_indices.shape[0]
+    base_dtype = (
+        jnp.int64 if E_local > np.iinfo(np.int32).max else jnp.int32
+    )
 
     def _mine_local(ids):
         # ownership-masked local row index — zero answers for lanes this
@@ -122,34 +155,103 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
         mine = (ids >= 0) & (ids // rows_per_shard == my)
         return mine, jnp.where(mine, ids - my * rows_per_shard, 0)
 
+    def _local_row(r):
+        base = local_indptr[r].astype(base_dtype)
+        deg = (local_indptr[r + 1] - local_indptr[r]).astype(jnp.int32)
+        return base, deg
+
     def serve_deg(ids):
         mine, r = _mine_local(ids)
-        deg = (local_indptr[r + 1] - local_indptr[r]).astype(jnp.int32)
+        _, deg = _local_row(r)
         return jnp.where(mine, deg, 0)
 
     def serve_nbr(ids, offs):
         mine, r = _mine_local(ids)
-        base = local_indptr[r].astype(jnp.int64) if E_local > np.iinfo(
-            np.int32).max else local_indptr[r].astype(jnp.int32)
+        base, _ = _local_row(r)
         epos = base[:, None] + offs.astype(base.dtype)
         nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
         return jnp.where(mine[:, None], nbr, 0).astype(jnp.int32)
 
-    route = BucketRoute(
-        s, valid, s // rows_per_shard, axis=axis, num_shards=num_shards,
-        cap=cap,
-    )
-    # hop pair 1: ids out, degrees back — the requester needs deg to draw
-    # the same offsets the replicated kernel would
-    deg = route.exchange(serve_deg)
-    # identical draw scheme/key discipline as ops.sample.sample_layer
-    kj, kr = jax.random.split(key)
-    off_nr, mask_sel = stratified_offsets(kj, deg, k)
-    off = rotate_offsets(kr, off_nr, deg, k)
-    mask = valid[:, None] & mask_sel
-    # hop pair 2: offsets out (same buckets, ids not re-sent), neighbor
-    # blocks back
-    nbr = route.exchange(serve_nbr, payload=off)
+    if route is None:
+        route = BucketRoute(
+            s, valid, s // rows_per_shard, axis=axis, num_shards=num_shards,
+            cap=cap,
+        )
+
+    if weighted:
+        # weighted hop: (1) ids out / degrees back, (2) row weight totals
+        # back (same buckets, f32 — one answer dtype per exchange), (3)
+        # the requester's uniform block out / weight-drawn neighbor ids
+        # back. The requester consumes the key UNSPLIT over the same
+        # (S, k) uniform block as ops.sample.weighted_offsets, and the
+        # owner's prefix slice is bitwise identical to the replicated
+        # array's row segment — bit parity by construction.
+        def serve_tot(ids):
+            mine, r = _mine_local(ids)
+            base, deg = _local_row(r)
+            end = jnp.clip(base + deg.astype(base.dtype) - 1, 0, E_local - 1)
+            tot = local_cum_weights[end]
+            return jnp.where(mine & (deg > 0), tot, 0.0)
+
+        def serve_wnbr(ids, u):
+            mine, r = _mine_local(ids)
+            base, deg = _local_row(r)
+            off = _cdf_search(local_cum_weights, u, base, deg, search_iters)
+            i = jnp.arange(k, dtype=jnp.int32)[None, :]
+            degc = deg[:, None]
+            # the replicated kernel's take-all override (weighted_offsets):
+            # local deg equals global deg, so this matches exactly
+            off = jnp.where(
+                degc <= k, jnp.minimum(i, jnp.maximum(degc - 1, 0)), off
+            )
+            epos = base[:, None] + off.astype(base.dtype)
+            nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
+            return jnp.where(mine[:, None], nbr, 0).astype(jnp.int32)
+
+        deg = route.exchange(serve_deg)
+        tot = route.exchange(serve_tot)
+        tot = jnp.where(deg > 0, tot, 1.0)
+        u = jax.random.uniform(
+            key, (S, k), dtype=local_cum_weights.dtype
+        ) * tot[:, None]
+        nbr = route.exchange(serve_wnbr, payload=u)
+        i = jnp.arange(k, dtype=jnp.int32)[None, :]
+        mask = valid[:, None] & (i < jnp.minimum(deg[:, None], k))
+    elif time_window is not None:
+        # temporal hop: the owner answers each routed row's in-window slot
+        # range (first, deg_t) — both int32, so they ride ONE exchange —
+        # and the requester draws the replicated scheme over the masked
+        # degrees, rebasing offsets by `first` before the neighbor hop.
+        lo_t, hi_t = time_window
+
+        def serve_window(ids):
+            mine, r = _mine_local(ids)
+            base, deg = _local_row(r)
+            first, deg_t = temporal_window_counts(
+                local_edge_time, base, deg, lo_t, hi_t, search_iters
+            )
+            out = jnp.stack([first, deg_t], axis=-1)
+            return jnp.where(mine[:, None], out, 0)
+
+        win = route.exchange(serve_window)
+        first, deg = win[:, 0], win[:, 1]
+        kj, kr = jax.random.split(key)
+        off_nr, mask_sel = stratified_offsets(kj, deg, k)
+        off = rotate_offsets(kr, off_nr, deg, k)
+        mask = valid[:, None] & mask_sel
+        nbr = route.exchange(serve_nbr, payload=first[:, None] + off)
+    else:
+        # hop pair 1: ids out, degrees back — the requester needs deg to
+        # draw the same offsets the replicated kernel would
+        deg = route.exchange(serve_deg)
+        # identical draw scheme/key discipline as ops.sample.sample_layer
+        kj, kr = jax.random.split(key)
+        off_nr, mask_sel = stratified_offsets(kj, deg, k)
+        off = rotate_offsets(kr, off_nr, deg, k)
+        mask = valid[:, None] & mask_sel
+        # hop pair 2: offsets out (same buckets, ids not re-sent),
+        # neighbor blocks back
+        nbr = route.exchange(serve_nbr, payload=off)
     nbr = jnp.where(mask, nbr, -1).astype(jnp.int32)
     counts = jnp.where(valid, jnp.minimum(deg, k), 0)
     return nbr, counts, route.overflow
@@ -158,7 +260,10 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
 def dist_multilayer_sample(local_indptr, local_indices, rows_per_shard: int,
                            seeds, num_seeds, key, sizes, caps, *, axis: str,
                            num_shards: int, routed_alpha: float | None = 2.0,
-                           dedup: str = "sort", node_count: int | None = None):
+                           dedup: str = "sort", node_count: int | None = None,
+                           weighted: bool = False, local_cum_weights=None,
+                           time_window=None, local_edge_time=None,
+                           search_iters: int = 0):
     """Multi-layer distributed sample+reindex loop (per-device body).
 
     The sharded-topology twin of ``sampling.sampler.multilayer_sample`` —
@@ -183,6 +288,9 @@ def dist_multilayer_sample(local_indptr, local_indices, rows_per_shard: int,
             nbr, counts, hop_ov = dist_sample_layer(
                 local_indptr, local_indices, rows_per_shard, cur, cur_n, k,
                 sub, axis=axis, num_shards=num_shards, cap=cap,
+                weighted=weighted, local_cum_weights=local_cum_weights,
+                time_window=time_window, local_edge_time=local_edge_time,
+                search_iters=search_iters,
             )
         hop_overflows.append(hop_ov)
         with trace_scope(f"reindex_layer_{l}"):
@@ -215,9 +323,13 @@ class DistGraphSageSampler(GraphSageSampler):
     shard owning their CSR row (see the module docstring for the comm
     model and the bit-parity contract).
 
-    Constraints vs the replicated sampler: HBM mode, the ``xla`` kernel,
-    unweighted, no ``with_eid`` (those paths stay on the replicated
-    ``GraphSageSampler``; the sharded CSR slices carry neither weights nor
+    Supports the replicated sampler's ``weighted=True`` (the shards carry
+    row-local prefix-weight slices and the owner answers inverse-CDF
+    draws — see ``dist_sample_layer``) and ``time_window`` (owner-answered
+    in-window slot ranges) biased draws, each bit-identical to its
+    replicated counterpart. Constraints vs the replicated sampler: HBM
+    mode, the ``xla`` kernel, no ``with_eid`` (that path stays on the
+    replicated ``GraphSageSampler``; the sharded CSR slices do not carry
     eid). ``routed_alpha`` is the shared capped-bucket routing budget —
     ``cap = ceil(alpha * L / F)`` lanes per destination per hop; ``None``
     = uncapped full-length buckets. The ``DistributedTrainer`` drives this
@@ -240,6 +352,7 @@ class DistGraphSageSampler(GraphSageSampler):
         frontier_caps=None,
         seed: int = 0,
         weighted: bool = False,
+        time_window=None,
         auto_margin: float = 1.25,
         kernel: str = "xla",
         with_eid: bool = False,
@@ -257,15 +370,11 @@ class DistGraphSageSampler(GraphSageSampler):
             )
         if mesh is None:
             raise ValueError("topo_sharding='mesh' requires mesh=")
-        if weighted:
-            raise NotImplementedError(
-                "weighted sampling over a sharded topology is not supported; "
-                "use the replicated GraphSageSampler"
-            )
         if with_eid:
-            raise NotImplementedError(
+            raise ValueError(
                 "with_eid over a sharded topology is not supported; the "
-                "sharded CSR slices do not carry eid"
+                "sharded CSR slices do not carry eid — use the replicated "
+                "GraphSageSampler"
             )
         if str(kernel) != "xla":
             raise ValueError(
@@ -303,8 +412,9 @@ class DistGraphSageSampler(GraphSageSampler):
         super().__init__(
             csr_topo, sizes, device=device, mode=mode,
             seed_capacity=seed_capacity, frontier_caps=frontier_caps,
-            seed=seed, weighted=weighted, auto_margin=auto_margin,
-            kernel=kernel, with_eid=with_eid, dedup=dedup,
+            seed=seed, weighted=weighted, time_window=time_window,
+            auto_margin=auto_margin, kernel=kernel, with_eid=with_eid,
+            dedup=dedup,
         )
         self.topo_sharding = "mesh"
 
@@ -322,7 +432,22 @@ class DistGraphSageSampler(GraphSageSampler):
     # -- topology placement (overrides the replicated upload) ---------------
 
     def _init_topo(self, device_topo):
-        return ShardedTopology(self.mesh, self.csr_topo, axis=self.axis)
+        return ShardedTopology(
+            self.mesh, self.csr_topo, axis=self.axis,
+            with_weights=self.weighted,
+            with_times=self.time_window is not None,
+        )
+
+    def _topo_operands(self) -> tuple:
+        """Per-shard topology arrays, in the order the compiled body
+        expects them: indptr, indices, then whichever edge attributes this
+        sampler's draw needs (all ``(F, ...)`` with ``P(axis, None)``)."""
+        ops = [self.topo.indptr, self.topo.indices]
+        if self.weighted:
+            ops.append(self.topo.cum_weights)
+        if self.time_window is not None:
+            ops.append(self.topo.edge_time)
+        return tuple(ops)
 
     def replan(self, mesh) -> "DistGraphSageSampler":
         """Re-partition the topology onto a different mesh (elastic
@@ -360,15 +485,28 @@ class DistGraphSageSampler(GraphSageSampler):
         ids_axes = tuple(mesh.axis_names)
         other_axes = tuple(a for a in mesh.axis_names if a != axis)
         n_layers = len(sizes)
+        weighted = self.weighted
+        time_window = self.time_window
+        iters = self.topo.search_iters
+        n_topo = len(self._topo_operands())
 
-        def body(indptr_blk, indices_blk, seeds, key):
+        def body(*args):
+            # args: indptr, indices, [cum_weights], [edge_time], seeds, key
+            # — the per-shard (1, ...) blocks of self._topo_operands()
+            topo_blks, (seeds, key) = args[:n_topo], args[n_topo:]
+            extra = list(topo_blks[2:])
+            cum_blk = extra.pop(0)[0] if weighted else None
+            time_blk = extra.pop(0)[0] if time_window is not None else None
             key = jax.random.fold_in(key, _worker_index(mesh))
             num_seeds = jnp.sum((seeds >= 0).astype(jnp.int32))
             (n_id, n_count, adjs, overflow, e_cnts, f_cnts,
              hop_ovs) = dist_multilayer_sample(
-                indptr_blk[0], indices_blk[0], rps, seeds, num_seeds, key,
+                topo_blks[0][0], topo_blks[1][0], rps, seeds, num_seeds, key,
                 sizes, caps, axis=axis, num_shards=F, routed_alpha=alpha,
                 dedup=dedup, node_count=n,
+                weighted=weighted, local_cum_weights=cum_blk,
+                time_window=time_window, local_edge_time=time_blk,
+                search_iters=iters,
             )
             eis = tuple(a.edge_index for a in adjs)
             # per-worker scalar row: [n_count, frontier_overflow,
@@ -385,7 +523,9 @@ class DistGraphSageSampler(GraphSageSampler):
             shard_map(
                 body,
                 mesh=mesh,
-                in_specs=(P(axis, None), P(axis, None), P(ids_axes), P()),
+                in_specs=(
+                    (P(axis, None),) * n_topo + (P(ids_axes), P())
+                ),
                 out_specs=(
                     P(ids_axes),
                     tuple(P(None, ids_axes) for _ in range(n_layers)),
@@ -452,7 +592,7 @@ class DistGraphSageSampler(GraphSageSampler):
         )
         run, used_caps = self._compiled(cap)
         n_id, eis, scal, hop_ov = run(
-            self.topo.indptr, self.topo.indices, dev_seeds, key
+            *self._topo_operands(), dev_seeds, key
         )
         if self._auto_caps:
             n_layers = len(self.sizes)
@@ -482,7 +622,7 @@ class DistGraphSageSampler(GraphSageSampler):
                     break
                 run, used_caps = self._compiled(cap)
                 n_id, eis, scal, hop_ov = run(
-                    self.topo.indptr, self.topo.indices, dev_seeds, key
+                    *self._topo_operands(), dev_seeds, key
                 )
                 first_plan = False
         self.last_sample_overflow = hop_ov

@@ -287,9 +287,7 @@ class HeteroGraphSampler:
         else:
             weighted_rels = []
         self.weighted_rels = frozenset(weighted_rels)
-        self.dev_topos = topo.to_device(
-            self.mode, with_eid=self.with_eid, weighted_rels=self.weighted_rels
-        )
+        self.dev_topos = self._init_topo()
         self._seed_capacity = seed_capacity
         if frontier_caps not in (None, "auto"):
             raise ValueError(
@@ -304,6 +302,15 @@ class HeteroGraphSampler:
         self._key = jax.random.PRNGKey(seed)
         self._call = 0
         self._compiled_cache = {}
+
+    def _init_topo(self):
+        """Place every relation's CSR on device. The mesh-sharded sampler
+        (``sampling.dist_hetero.DistHeteroSampler``) overrides this to
+        partition each relation across the mesh instead of replicating."""
+        return self.topo.to_device(
+            self.mode, with_eid=self.with_eid,
+            weighted_rels=self.weighted_rels,
+        )
 
     # -- static planning ----------------------------------------------------
 

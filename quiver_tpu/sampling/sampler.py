@@ -93,7 +93,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
-                      kernel="xla", with_eid=False, dedup="sort"):
+                      kernel="xla", with_eid=False, dedup="sort",
+                      time_window=None):
     """The multi-layer sample+reindex loop (jit- and shard_map-composable).
 
     One trace covers all layers — the fused analogue of the reference's
@@ -111,6 +112,10 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
     """
     if with_eid and kernel == "pallas":
         raise ValueError("kernel='pallas' does not support with_eid")
+    if time_window is not None and kernel == "pallas":
+        raise ValueError(
+            "kernel='pallas' does not support time_window; use kernel='xla'"
+        )
     dedup = resolve_dedup(dedup)  # validates; maps "auto" per platform
     adjs = []
     edge_counts = []
@@ -139,10 +144,12 @@ def multilayer_sample(topo, seeds, num_seeds, key, sizes, caps, weighted=False,
                     nbr, counts = sample_layer(topo, cur, cur_n, k, sub)
             elif with_eid:
                 nbr, counts, eids = sample_layer(topo, cur, cur_n, k, sub,
-                                                 weighted=weighted, with_eid=True)
+                                                 weighted=weighted, with_eid=True,
+                                                 time_window=time_window)
             else:
                 nbr, counts = sample_layer(topo, cur, cur_n, k, sub,
-                                           weighted=weighted)
+                                           weighted=weighted,
+                                           time_window=time_window)
         with trace_scope(f"reindex_layer_{l}"):
             # dedup="map": sort-free scatter-min dedup over a dense
             # (node_count,) position map — the reference's hash-table
@@ -197,6 +204,10 @@ class GraphSageSampler:
         batch overflows the planned caps) for right-sized programs.
       seed: base PRNG seed (per-call keys derive from it + a call counter,
         like the reference's per-launch curand reseed, cuda_random.cu.hpp:21-23).
+      time_window: optional ``(lo, hi)`` timestamp pair — every hop draws
+        only from edges with ``lo <= t <= hi`` (masked degrees; expired
+        edges never appear). Requires ``csr_topo.set_edge_time()``, HBM
+        mode, kernel="xla", and is mutually exclusive with ``weighted``.
       auto_margin: headroom factor for "auto" caps (>= 1).
       kernel: "xla" (exact stratified sampler) or "pallas" (windowed-DMA
         Pallas kernel, ops/pallas/sample.py — HBM mode, unweighted only;
@@ -254,6 +265,7 @@ class GraphSageSampler:
         frontier_caps: Sequence[int] | str | None = None,
         seed: int = 0,
         weighted: bool = False,
+        time_window=None,
         auto_margin: float = 1.25,
         kernel: str = "xla",
         with_eid: bool = False,
@@ -278,6 +290,15 @@ class GraphSageSampler:
             raise ValueError(f"fanouts must be >= 1 or -1, got {sizes}")
         self.weighted = bool(weighted)
         self.with_eid = bool(with_eid)
+        if time_window is not None:
+            lo_t, hi_t = time_window  # two scalars, baked into the program
+            time_window = (float(lo_t), float(hi_t))
+            if self.weighted:
+                raise ValueError(
+                    "time_window cannot be combined with weighted=True; "
+                    "pick one biased draw per sampler"
+                )
+        self.time_window = time_window
         self.kernel = str(kernel)
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"kernel must be 'xla' or 'pallas', got {kernel!r}")
@@ -287,12 +308,22 @@ class GraphSageSampler:
                 raise ValueError("kernel='pallas' supports unweighted sampling only")
             if self.with_eid:
                 raise ValueError("kernel='pallas' does not support with_eid")
+            if self.time_window is not None:
+                raise ValueError(
+                    "kernel='pallas' does not support time_window; use "
+                    "kernel='xla'"
+                )
             if SampleMode.parse(mode) is not SampleMode.HBM:
                 raise ValueError("kernel='pallas' requires mode='HBM' (GPU) topology")
         if self.weighted and csr_topo.cum_weights is None:
             raise ValueError(
                 "weighted=True requires edge weights; call "
                 "csr_topo.set_edge_weight() or pass edge_weight= to CSRTopo"
+            )
+        if self.time_window is not None and csr_topo.edge_time is None:
+            raise ValueError(
+                "time_window requires edge timestamps; call "
+                "csr_topo.set_edge_time() or pass edge_time= to CSRTopo"
             )
         self.topo = self._init_topo(device_topo)
         # the committed mutation version the device placement reflects; a
@@ -361,9 +392,16 @@ class GraphSageSampler:
                     "device_topo lacks cum_weights but weighted=True; "
                     "rebuild with to_device(with_weights=True)"
                 )
+            if (self.time_window is not None
+                    and getattr(device_topo, "edge_time", None) is None):
+                raise ValueError(
+                    "device_topo lacks edge_time but time_window is set; "
+                    "rebuild with to_device(with_times=True)"
+                )
             return device_topo
         return self.csr_topo.to_device(
-            self.mode, with_eid=self.with_eid, with_weights=self.weighted
+            self.mode, with_eid=self.with_eid, with_weights=self.weighted,
+            with_times=self.time_window is not None,
         )
 
     # -- streaming-mutation versioning --------------------------------------
@@ -442,12 +480,14 @@ class GraphSageSampler:
         kernel = self.kernel
         with_eid = self.with_eid
         dedup = self.dedup
+        time_window = self.time_window
 
         @jax.jit
         def run(topo, seeds, num_seeds, key):
             return multilayer_sample(topo, seeds, num_seeds, key, sizes, caps,
                                      weighted=weighted, kernel=kernel,
-                                     with_eid=with_eid, dedup=dedup)
+                                     with_eid=with_eid, dedup=dedup,
+                                     time_window=time_window)
 
         self._compiled_cache[cache_key] = (run, caps)
         while len(self._compiled_cache) > self.compiled_cache_size:

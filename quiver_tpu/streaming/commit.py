@@ -92,23 +92,36 @@ class CommitResult:
 
 
 def merge_csr(indptr: np.ndarray, indices: np.ndarray,
-              inserts: np.ndarray | None, deletes: np.ndarray | None):
+              inserts: np.ndarray | None, deletes: np.ndarray | None,
+              attrs: dict | None = None):
     """Merge COO edge inserts/deletes into fresh CSR arrays.
 
     Returns ``(new_indptr, new_indices, touched)`` where ``touched`` is
     the boolean per-row mask of rows whose adjacency changed. The input
     arrays are read-only; untouched rows are copied verbatim in
     contiguous runs.
+
+    ``attrs`` (optional) threads per-edge attribute columns through the
+    merge: ``{name: (old_column, insert_column)}`` where ``old_column``
+    is ``(E,)`` in the committed CSR's slot order and ``insert_column``
+    is one value per ``inserts`` column (or None when there are no
+    inserts). Every kept slot keeps its attribute, every appended insert
+    brings its own, and a deleted slot's attribute is dropped with it —
+    so the columns stay aligned with ``new_indices`` slot for slot. With
+    ``attrs`` the return gains a fourth element ``{name: new_column}``.
     """
     indptr = np.asarray(indptr, dtype=np.int64)
     indices = np.asarray(indices)
     n = int(indptr.shape[0] - 1)
     deg = np.diff(indptr)
 
-    ins_by_row: dict[int, list[int]] = {}
+    # per destination row: (neighbor id, insert column index) — the column
+    # index is the provenance attribute columns are gathered by
+    ins_by_row: dict[int, list[tuple[int, int]]] = {}
     if inserts is not None and inserts.shape[1]:
-        for s, d in zip(inserts[0].tolist(), inserts[1].tolist()):
-            ins_by_row.setdefault(int(s), []).append(int(d))
+        for i, (s, d) in enumerate(
+                zip(inserts[0].tolist(), inserts[1].tolist())):
+            ins_by_row.setdefault(int(s), []).append((int(d), i))
     del_by_row: dict[int, dict[int, int]] = {}
     if deletes is not None and deletes.shape[1]:
         for s, d in zip(deletes[0].tolist(), deletes[1].tolist()):
@@ -136,6 +149,12 @@ def merge_csr(indptr: np.ndarray, indices: np.ndarray,
     new_indptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(new_deg, out=new_indptr[1:])
     new_indices = np.empty(int(new_indptr[-1]), dtype=np.int64)
+    new_attrs = None
+    if attrs is not None:
+        new_attrs = {
+            name: np.empty(int(new_indptr[-1]), dtype=old.dtype)
+            for name, (old, _) in attrs.items()
+        }
 
     touched_rows = np.flatnonzero(touched)
     # copy untouched spans between consecutive touched rows in single
@@ -145,24 +164,46 @@ def merge_csr(indptr: np.ndarray, indices: np.ndarray,
         if r > prev:  # untouched run [prev, r)
             new_indices[new_indptr[prev]:new_indptr[r]] = \
                 indices[indptr[prev]:indptr[r]]
+            if attrs is not None:
+                for name, (old_col, _) in attrs.items():
+                    new_attrs[name][new_indptr[prev]:new_indptr[r]] = \
+                        old_col[indptr[prev]:indptr[r]]
         old = indices[indptr[r]:indptr[r + 1]].tolist()
         pending = dict(del_by_row.get(r, {}))
         kept = []
-        for v in old:
+        src = []  # provenance: old slot position >= 0, insert col -(i+1)
+        for j, v in enumerate(old):
             if pending.get(v, 0) > 0:
                 pending[v] -= 1  # earliest occurrence removed first
             else:
                 kept.append(v)
-        for v in ins_by_row.get(r, ()):  # inserts append, ingestion order
+                src.append(int(indptr[r]) + j)
+        # inserts append, ingestion order
+        for v, i in ins_by_row.get(r, ()):
             if pending.get(v, 0) > 0:
                 pending[v] -= 1  # delete staged after the insert it names
             else:
                 kept.append(v)
+                src.append(-(i + 1))
         new_indices[new_indptr[r]:new_indptr[r + 1]] = kept
+        if attrs is not None and kept:
+            src = np.asarray(src, dtype=np.int64)
+            old_slot = src >= 0
+            for name, (old_col, ins_col) in attrs.items():
+                seg = np.empty(len(kept), dtype=old_col.dtype)
+                seg[old_slot] = old_col[src[old_slot]]
+                if (~old_slot).any():
+                    seg[~old_slot] = ins_col[-src[~old_slot] - 1]
+                new_attrs[name][new_indptr[r]:new_indptr[r + 1]] = seg
         prev = r + 1
     if prev < n:
         new_indices[new_indptr[prev]:] = indices[indptr[prev]:]
-    return new_indptr, new_indices, touched
+        if attrs is not None:
+            for name, (old_col, _) in attrs.items():
+                new_attrs[name][new_indptr[prev]:] = old_col[indptr[prev]:]
+    if attrs is None:
+        return new_indptr, new_indices, touched
+    return new_indptr, new_indices, touched, new_attrs
 
 
 def _untouched_crc(indptr: np.ndarray, indices: np.ndarray,
@@ -263,9 +304,14 @@ class StreamingGraph:
     module docstring for the protocol.
 
     Args:
-      csr_topo: the committed host CSR. Weighted topologies and
-        ``eid``-tracking consumers are rejected (mutation drops COO
-        provenance; weights do not survive a merge).
+      csr_topo: the committed host CSR. A weighted and/or timestamped
+        topology is supported: its attribute columns ride the merge slot
+        for slot (kept edges keep theirs, inserts must supply their own
+        through ``DeltaBatch.edge_weights``/``edge_times`` — admission
+        rejects attribute-less inserts whole with a named reason — and a
+        deleted slot's attribute is dropped with it). ``eid`` provenance
+        does not survive mutation (``with_eid`` consumers re-place
+        against the rebuilt CSR).
       feature: optional ShardedFeature whose rows feature deltas update
         (row updates publish in the same transaction as the topology
         merge; its ``note_degree_update`` re-tiering hook runs after a
@@ -282,13 +328,12 @@ class StreamingGraph:
             raise ValueError(
                 f"duplicates must be 'error' or 'allow', got {duplicates!r}"
             )
-        if csr_topo.edge_weight is not None:
-            raise NotImplementedError(
-                "streaming mutation of a weighted topology is not "
-                "supported (per-edge weights do not survive the merge); "
-                "mutate the unweighted CSR and re-attach weights"
-            )
         self.csr_topo = csr_topo
+        # the admission schema mirrors the committed topology's edge
+        # attributes: inserts must carry exactly these (validate_delta
+        # rejects mismatches whole, both directions)
+        self.needs_weights = csr_topo.edge_weight is not None
+        self.needs_times = csr_topo.edge_time is not None
         self.feature = feature
         if feature is not None and not hasattr(feature, "apply_row_updates"):
             raise ValueError(
@@ -387,6 +432,8 @@ class StreamingGraph:
                 delta, self.csr_topo.node_count, fs,
                 live_pair_counts=self._live_pair_counts(),
                 duplicates=self.duplicates,
+                needs_weights=self.needs_weights,
+                needs_times=self.needs_times,
             )
         except DeltaRejected as e:
             self._quarantine("ingest", str(e), (delta,))
@@ -454,14 +501,40 @@ class StreamingGraph:
             old_indptr = np.asarray(topo.indptr, dtype=np.int64)
             old_indices = np.asarray(topo.indices)
             topo_changed = bool(n_ins or n_del)
+            # the topology's attribute columns ride the merge: one insert
+            # column per staged batch (admission guaranteed alignment),
+            # concatenated in the same order as the inserts themselves
+            attrs = None
+            if self.needs_weights or self.needs_times:
+                attrs = {}
+                for name, needed, old_col in (
+                    ("edge_weight", self.needs_weights, topo.edge_weight),
+                    ("edge_time", self.needs_times, topo.edge_time),
+                ):
+                    if not needed:
+                        continue
+                    parts = [
+                        getattr(d, name + "s") for d in staged
+                        if d.edge_inserts is not None
+                        and d.edge_inserts.shape[1]
+                    ]
+                    attrs[name] = (
+                        np.asarray(old_col),
+                        np.concatenate(parts) if parts else None,
+                    )
             if inject_failure == "merge":
                 raise DeltaRejected(
                     "injected commit failure at stage 'merge' (chaos seam)"
                 )
+            new_attrs = {}
             if topo_changed:
-                new_indptr, new_indices, touched = merge_csr(
-                    old_indptr, old_indices, inserts, deletes
+                merged = merge_csr(
+                    old_indptr, old_indices, inserts, deletes, attrs
                 )
+                if attrs is None:
+                    new_indptr, new_indices, touched = merged
+                else:
+                    new_indptr, new_indices, touched, new_attrs = merged
             else:
                 new_indptr, new_indices = old_indptr, old_indices
                 touched = np.zeros(topo.node_count, dtype=bool)
@@ -490,7 +563,11 @@ class StreamingGraph:
             ) from e
         # ---- publish: everything above is verified and aside ----
         if topo_changed:
-            topo._publish_mutation(new_indptr, new_indices)
+            topo._publish_mutation(
+                new_indptr, new_indices,
+                edge_weight=new_attrs.get("edge_weight"),
+                edge_time=new_attrs.get("edge_time"),
+            )
         if upd_ids is not None:
             self.feature.apply_row_updates(upd_ids, upd_rows)
         self._staged.clear()

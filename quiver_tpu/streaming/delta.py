@@ -18,6 +18,16 @@ order:
   partitioning assumes);
 * **non-finite scan** — a NaN/Inf feature row is rejected here, not
   cached and served;
+* **edge attributes** — when the committed topology carries per-edge
+  weights and/or timestamps, every inserted edge must supply a matching
+  attribute (``edge_weights``/``edge_times`` aligned to the
+  ``edge_inserts`` columns), validated with the SAME rules as
+  ``CSRTopo.set_edge_weight``/``set_edge_time`` (finite; weights
+  non-negative); a batch that omits them — or supplies them to a
+  topology that doesn't carry the attribute — is rejected whole with a
+  named reason (``missing-edge-weights`` / ``unexpected-edge-times`` /
+  ...), so a commit can never publish a weighted/timestamped CSR with
+  attribute-less rows;
 * **duplicate policy** — WITHIN one batch, duplicate edge inserts and
   duplicate update ids are rejected under ``duplicates="error"`` (the
   default) or collapsed/allowed under ``"allow"`` (updates: last wins).
@@ -56,14 +66,19 @@ class DeltaBatch:
     (``[0]`` = source row, ``[1]`` = destination) over the EXISTING node
     id space; ``update_ids``/``update_rows`` are the feature rows to
     overwrite (original node ids + their new ``(U, feature_dim)``
-    values). Any field may be ``None``. ``tag`` labels the batch in
-    quarantine records and logs.
+    values). ``edge_weights``/``edge_times`` are per-inserted-edge
+    attributes aligned to the ``edge_inserts`` columns — REQUIRED when
+    the committed topology is weighted/timestamped, inadmissible when it
+    is not (admission enforces both directions). Any field may be
+    ``None``. ``tag`` labels the batch in quarantine records and logs.
     """
 
     edge_inserts: np.ndarray | None = None
     edge_deletes: np.ndarray | None = None
     update_ids: np.ndarray | None = None
     update_rows: np.ndarray | None = None
+    edge_weights: np.ndarray | None = None
+    edge_times: np.ndarray | None = None
     tag: str = ""
 
     def counts(self) -> tuple[int, int, int]:
@@ -110,6 +125,48 @@ def _check_range(arr: np.ndarray, n: int, what: str) -> None:
         )
 
 
+def _admit_edge_attr(vals, n_ins: int, needed: bool, name: str, *,
+                     nonneg: bool) -> np.ndarray | None:
+    """Admission-check one per-inserted-edge attribute column against the
+    committed topology's schema (``needed``). Returns the normalized f32
+    column (or None) or raises :class:`DeltaRejected` with a named
+    reason (``missing-``/``unexpected-``/``bad-`` + ``name``)."""
+    attr = name.replace("-", "_")  # DeltaBatch field name in messages
+    if needed and n_ins and vals is None:
+        raise DeltaRejected(
+            f"missing-{name}: the committed topology carries per-edge "
+            f"{name.split('-')[1]}; every inserted edge must supply one "
+            f"(DeltaBatch.{attr} aligned to the edge_inserts columns)"
+        )
+    if vals is None:
+        return None
+    if not needed:
+        raise DeltaRejected(
+            f"unexpected-{name}: the committed topology carries no "
+            f"per-edge {name.split('-')[1]}; attach them to the CSR "
+            f"before streaming attributed deltas"
+        )
+    vals = np.asarray(vals).reshape(-1)
+    if vals.shape[0] != n_ins:
+        raise DeltaRejected(
+            f"bad-{name}: need one entry per inserted edge ({n_ins}), "
+            f"got {vals.shape[0]}"
+        )
+    if not np.issubdtype(vals.dtype, np.number) or np.issubdtype(
+            vals.dtype, np.complexfloating):
+        raise DeltaRejected(
+            f"bad-{name}: must be real numbers, got dtype {vals.dtype}"
+        )
+    vals = vals.astype(np.float32)
+    if vals.size and not np.isfinite(vals).all():
+        raise DeltaRejected(f"bad-{name}: values must be finite")
+    if nonneg and vals.size and vals.min() < 0:
+        # the same rule as CSRTopo.set_edge_weight: a negative weight
+        # would silently degenerate the CDF search
+        raise DeltaRejected(f"bad-{name}: values must be non-negative")
+    return vals
+
+
 def validate_delta(
     delta: DeltaBatch,
     node_count: int,
@@ -117,6 +174,8 @@ def validate_delta(
     *,
     live_pair_counts: dict[int, int] | None = None,
     duplicates: str = "error",
+    needs_weights: bool = False,
+    needs_times: bool = False,
 ) -> DeltaBatch:
     """Admission-validate ``delta``; return the normalized batch or raise
     :class:`DeltaRejected` naming the first failing check.
@@ -129,6 +188,10 @@ def validate_delta(
     caller owns it). ``duplicates`` is the duplicate policy: ``"error"``
     rejects duplicate edge inserts and duplicate update ids; ``"allow"``
     admits parallel edges and collapses duplicate update ids last-wins.
+    ``needs_weights``/``needs_times`` mirror the committed topology's
+    attributes: inserted edges must supply exactly the attributes the
+    topology carries (named rejections both ways — see the module
+    docstring).
     """
     if duplicates not in ("error", "allow"):
         raise ValueError(
@@ -150,6 +213,18 @@ def validate_delta(
                     f"batch (duplicates='error'; pass 'allow' for "
                     f"parallel edges)"
                 )
+
+    # edge attributes must mirror the committed topology exactly: a
+    # weighted/timestamped CSR can never gain attribute-less rows, and an
+    # attribute on an unattributed topology is a schema error, not noise
+    n_ins = 0 if ins is None else int(ins.shape[1])
+    wts = _admit_edge_attr(
+        delta.edge_weights, n_ins, needs_weights, "edge-weights",
+        nonneg=True,
+    )
+    tms = _admit_edge_attr(
+        delta.edge_times, n_ins, needs_times, "edge-times", nonneg=False,
+    )
 
     if delta.edge_deletes is not None:
         dele = _as_edge_array(delta.edge_deletes, "edge_deletes")
@@ -221,5 +296,6 @@ def validate_delta(
 
     return DeltaBatch(
         edge_inserts=ins, edge_deletes=dele,
-        update_ids=ids, update_rows=rows, tag=delta.tag,
+        update_ids=ids, update_rows=rows,
+        edge_weights=wts, edge_times=tms, tag=delta.tag,
     )

@@ -21,12 +21,17 @@ SECTIONS = [
     ("quiver_tpu.core.topology", "Graph topology (CSRTopo, device placement)"),
     ("quiver_tpu.core.sharded_topology",
      "Mesh-sharded topology (CSR partitioned across chips)"),
+    ("quiver_tpu.core.hetero_sharded",
+     "Mesh-sharded heterogeneous topology (per-relation partitions)"),
     ("quiver_tpu.core.config", "Config enums + byte-size parser"),
     ("quiver_tpu.core.memory", "Device/host memory placement"),
+    ("quiver_tpu.sampling", "Public sampling surface (the sampler family)"),
     ("quiver_tpu.sampling.sampler", "GraphSageSampler (homo)"),
     ("quiver_tpu.sampling.dist",
      "Distributed sampler over a mesh-sharded topology"),
     ("quiver_tpu.sampling.hetero", "Heterogeneous sampler"),
+    ("quiver_tpu.sampling.dist_hetero",
+     "Distributed heterogeneous sampler (shared route plan per hop/type)"),
     ("quiver_tpu.sampling.saint", "GraphSAINT samplers"),
     ("quiver_tpu.feature.feature", "Tiered feature store"),
     ("quiver_tpu.feature.shard", "Mesh-sharded feature store"),

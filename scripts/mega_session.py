@@ -90,6 +90,7 @@ ORDER = [
     ("feature-shard-routed-capped", 900),
     ("feature-threetier", 900),
     ("sampler-sharded", 900),
+    ("sampler-hetero-sharded", 900),
     ("acceptance", 1800),
     ("sweep", 2400),
 ]

@@ -1,0 +1,262 @@
+"""Heterogeneous distributed sampling differentials (quiver-hetero-dist).
+
+Parity bar: ``DistHeteroSampler`` — per-relation CSR slices partitioned
+across the mesh's feature axis, ONE shared BucketRoute plan per (hop,
+destination type) — must be BIT-IDENTICAL per worker block to the
+replicated ``HeteroGraphSampler`` with key ``fold_in(key, worker)``, at
+every mesh width, uniform and weighted, with and without forced bucket
+overflow (fallback-served lanes included). Routed overflow surfaces per
+(hop, edge type) through ``last_sample_overflow_by_rel``. End-to-end, an
+R-GCN trained off the dist sampler's per-worker blocks must reproduce
+the replicated loss trajectory bit-for-bit (slow lane).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from quiver_tpu import (
+    DistHeteroSampler,
+    HeteroCSRTopo,
+    HeteroFeature,
+    HeteroGraphSampler,
+)
+from quiver_tpu.models.rgcn import RGCN
+from quiver_tpu.parallel.mesh import make_mesh
+
+
+def _toy_schema(seed=0, n_paper=120, n_author=60, n_inst=20):
+    rng = np.random.default_rng(seed)
+    cites = np.stack([
+        rng.integers(0, n_paper, 400), rng.integers(0, n_paper, 400)
+    ])
+    writes = np.stack([
+        rng.integers(0, n_author, 300), rng.integers(0, n_paper, 300)
+    ])
+    affil = np.stack([
+        rng.integers(0, n_inst, 100), rng.integers(0, n_author, 100)
+    ])
+    num_nodes = {"paper": n_paper, "author": n_author, "inst": n_inst}
+    edges = {
+        ("paper", "cites", "paper"): cites,
+        ("author", "writes", "paper"): writes,
+        ("inst", "employs", "author"): affil,
+    }
+    return HeteroCSRTopo(num_nodes, edges), edges, num_nodes
+
+
+def _weighted_topo(seed=0):
+    topo, _, num_nodes = _toy_schema(seed=seed)
+    rng = np.random.default_rng(1)
+    for et in topo.relations:
+        topo.set_edge_weight(et, rng.random(topo.relations[et].edge_count)
+                             + 0.1)
+    return topo, num_nodes
+
+
+def _assert_hetero_parity(F, weighted, alpha, seeds=None, seed=0,
+                          sizes=(3, 2)):
+    """Every worker's dist HeteroSampleOutput equals the replicated
+    oracle's on that worker's seed block with key fold_in(key, worker):
+    per-type n_id and every relation's edge_index, bitwise."""
+    if weighted:
+        topo, _ = _weighted_topo(seed=seed)
+    else:
+        topo, _, _ = _toy_schema(seed=seed)
+    if seeds is None:
+        seeds = np.arange(48)
+    mesh = make_mesh(n_devices=F, data=1, feature=F)
+    dist = DistHeteroSampler(topo, list(sizes), input_type="paper",
+                             mesh=mesh, routed_alpha=alpha,
+                             weighted=weighted, seed=0)
+    base_key = jax.random.PRNGKey(7)
+    per = dist.sample_per_worker(seeds, key=base_key)
+    cap = per[0].batch_size
+
+    oracle = HeteroGraphSampler(topo, list(sizes), input_type="paper",
+                                seed_capacity=cap, weighted=weighted,
+                                seed=0)
+    run = oracle._compiled(cap)
+    for w, blk in enumerate(np.array_split(seeds, F)):
+        padded = np.full(cap, -1, np.int32)
+        padded[: len(blk)] = blk
+        frontier, _, layers, _, _ = run(
+            oracle.dev_topos, jnp.asarray(padded), jnp.int32(len(blk)),
+            jax.random.fold_in(base_key, w),
+        )
+        d = per[w]
+        assert set(frontier) == set(d.n_id)
+        for t in frontier:
+            assert np.array_equal(
+                np.asarray(frontier[t]), np.asarray(d.n_id[t])
+            ), f"n_id[{t}] diverged on worker {w}/{F}"
+        assert len(layers) == len(d.adjs)
+        for li, (la, lb) in enumerate(zip(layers, d.adjs)):
+            assert set(la.adjs) == set(lb.adjs)
+            for et in la.adjs:
+                assert np.array_equal(
+                    np.asarray(la.adjs[et].edge_index),
+                    np.asarray(lb.adjs[et].edge_index),
+                ), f"edge_index diverged: worker {w} layer {li} {et}"
+                assert la.adjs[et].size == lb.adjs[et].size
+    return dist
+
+
+# -- bit-parity differentials (fast lane: F=2) ------------------------------
+
+
+def test_dist_hetero_parity_uniform():
+    dist = _assert_hetero_parity(2, weighted=False, alpha=2.0)
+    # per-(hop, edge type) telemetry: one slot per active relation per hop
+    ov = dist.last_sample_overflow_by_rel
+    assert ov is not None and set(ov) == set(dist.overflow_slots)
+    assert all(li in (0, 1) for li, _ in ov) and all(v >= 0
+                                                     for v in ov.values())
+
+
+def test_dist_hetero_parity_weighted():
+    dist = _assert_hetero_parity(2, weighted=True, alpha=2.0)
+    assert dist.last_sample_overflow_by_rel is not None
+
+
+# -- forced overflow + width sweep (slow lane) ------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("F", [1, 4, 8])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_dist_hetero_parity_widths(F, weighted):
+    _assert_hetero_parity(F, weighted=weighted, alpha=2.0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("weighted", [False, True])
+def test_dist_hetero_forced_overflow_exact(weighted):
+    """Tiny routing budget: buckets overflow, the psum fallback serves the
+    overflowed lanes, results stay bit-identical, and the per-(hop, edge
+    type) counts surface."""
+    dist = _assert_hetero_parity(4, weighted=weighted, alpha=0.25)
+    ov = dist.last_sample_overflow_by_rel
+    assert sum(ov.values()) > 0, ov
+
+
+@pytest.mark.slow
+def test_dist_hetero_uncapped_alpha_none():
+    _assert_hetero_parity(2, weighted=True, alpha=None)
+
+
+# -- constructor guards -----------------------------------------------------
+
+
+def test_dist_hetero_constructor_guards():
+    topo, _, _ = _toy_schema()
+    mesh = make_mesh(n_devices=2, data=1, feature=2)
+    with pytest.raises(ValueError, match="requires mesh="):
+        DistHeteroSampler(topo, [3], input_type="paper")
+    with pytest.raises(ValueError, match="with_eid over a sharded"):
+        DistHeteroSampler(topo, [3], input_type="paper", mesh=mesh,
+                          with_eid=True)
+    with pytest.raises(ValueError, match="HBM"):
+        DistHeteroSampler(topo, [3], input_type="paper", mesh=mesh,
+                          mode="HOST")
+    with pytest.raises(ValueError, match="routed_alpha"):
+        DistHeteroSampler(topo, [3], input_type="paper", mesh=mesh,
+                          routed_alpha=0.0)
+    # weighted needs the relations to actually carry weights
+    with pytest.raises(ValueError, match="weight"):
+        DistHeteroSampler(topo, [3], input_type="paper", mesh=mesh,
+                          weighted=True)
+
+
+# -- end-to-end R-GCN parity (slow lane) ------------------------------------
+
+
+@pytest.mark.slow
+def test_dist_hetero_rgcn_loss_parity():
+    """R-GCN trained off the dist sampler's per-worker blocks (grads
+    averaged across workers) reproduces the replicated trajectory
+    BIT-FOR-BIT — and still converges."""
+    topo, _, num_nodes = _toy_schema(seed=5)
+    F = 2
+    mesh = make_mesh(n_devices=F, data=1, feature=F)
+    cap = 16  # per-worker block == capacity: no padded label lanes
+    dist = DistHeteroSampler(topo, [4, 3], input_type="paper", mesh=mesh,
+                             seed_capacity=cap, seed=2)
+    rep = HeteroGraphSampler(topo, [4, 3], input_type="paper",
+                             seed_capacity=cap, seed=2)
+    rng = np.random.default_rng(0)
+    feats = {
+        t: rng.normal(size=(n, 16)).astype(np.float32)
+        for t, n in num_nodes.items()
+    }
+    feature = HeteroFeature.from_cpu_tensors(feats, device_cache_size="64M")
+    labels_all = rng.integers(0, 4, num_nodes["paper"]).astype(np.int32)
+    model = RGCN(hidden=32, num_classes=4, target_type="paper",
+                 num_layers=2)
+    tx = optax.adam(5e-3)
+
+    @jax.jit
+    def grad_step(params, x_dict, layers, labels, rng_key):
+        def loss_fn(p):
+            logp = model.apply({"params": p}, x_dict, layers, train=True,
+                               rngs={"dropout": rng_key})
+            ll = jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+            return -ll.mean()
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    def train(sample_fn, steps=20):
+        out0 = sample_fn(np.arange(F * cap), jax.random.PRNGKey(0))[0]
+        params = model.init(
+            {"params": jax.random.PRNGKey(0)}, feature[out0.n_id], out0.adjs
+        )["params"]
+        opt_state = tx.init(params)
+        losses = []
+        for i in range(steps):
+            seeds = np.random.default_rng(i).integers(
+                0, num_nodes["paper"], F * cap
+            )
+            outs = sample_fn(seeds, jax.random.PRNGKey(i))
+            grads_acc, loss_acc = None, 0.0
+            for o, blk in zip(outs, np.array_split(seeds, F)):
+                loss, grads = grad_step(
+                    params, feature[o.n_id], o.adjs,
+                    jnp.asarray(labels_all[blk]), jax.random.PRNGKey(i),
+                )
+                loss_acc += float(loss)
+                grads_acc = grads if grads_acc is None else jax.tree.map(
+                    jnp.add, grads_acc, grads
+                )
+            grads_acc = jax.tree.map(lambda g: g / F, grads_acc)
+            updates, opt_state = tx.update(grads_acc, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(loss_acc / F)
+        return losses
+
+    def dist_sample(seeds, key):
+        return dist.sample_per_worker(seeds, key=key)
+
+    run = rep._compiled(cap)
+
+    class _Out:
+        def __init__(self, n_id, adjs):
+            self.n_id, self.adjs = n_id, adjs
+
+    def rep_sample(seeds, key):
+        outs = []
+        for w, blk in enumerate(np.array_split(seeds, F)):
+            padded = np.full(cap, -1, np.int32)
+            padded[: len(blk)] = blk
+            frontier, _, layers, _, _ = run(
+                rep.dev_topos, jnp.asarray(padded), jnp.int32(len(blk)),
+                jax.random.fold_in(key, w),
+            )
+            outs.append(_Out(frontier, layers))
+        return outs
+
+    dist_losses = train(dist_sample)
+    rep_losses = train(rep_sample)
+    assert dist_losses == rep_losses, (dist_losses, rep_losses)
+    assert dist_losses[-1] < dist_losses[0], dist_losses

@@ -117,6 +117,70 @@ def test_dist_parity_mesh8():
     assert ov.shape == (2,) and np.all(ov >= 0)
 
 
+def test_dist_parity_weighted_mesh2():
+    """Weighted draws over the sharded path: the owner's inverse-CDF
+    search against its routed prefix-weight segment is bit-identical to
+    the replicated weighted sampler."""
+    topo = _graph(n=500)
+    topo.set_edge_weight(
+        np.random.default_rng(5).random(topo.edge_count) + 0.1
+    )
+    mesh = make_mesh(n_devices=2, data=1, feature=2)
+    dist = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                            dedup="sort", topo_sharding="mesh", mesh=mesh,
+                            weighted=True)
+    rep = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                           dedup="sort", weighted=True)
+    seeds = np.random.default_rng(6).integers(0, topo.node_count, 61)
+    _assert_worker_parity(dist, rep, seeds, jax.random.PRNGKey(11))
+
+
+def test_dist_parity_temporal_mesh2():
+    """Temporal windowed draws over the sharded path: owner-answered
+    (first, deg_t) in-window slot ranges, bit-identical to the replicated
+    time_window sampler."""
+    topo = _graph(n=500)
+    topo.set_edge_time(np.random.default_rng(8).random(topo.edge_count))
+    mesh = make_mesh(n_devices=2, data=1, feature=2)
+    win = (0.2, 0.8)
+    dist = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                            dedup="sort", topo_sharding="mesh", mesh=mesh,
+                            time_window=win)
+    rep = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                           dedup="sort", time_window=win)
+    seeds = np.random.default_rng(9).integers(0, topo.node_count, 61)
+    _assert_worker_parity(dist, rep, seeds, jax.random.PRNGKey(13))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["weighted", "temporal"])
+@pytest.mark.parametrize("F", [1, 4, 8])
+def test_dist_parity_attr_widths(kind, F):
+    """Weighted/temporal differential at the wider mesh widths, capped
+    tight enough to force routed overflow — the fallback must serve the
+    attributed hops exactly too."""
+    topo = _graph(n=500)
+    kw = {}
+    if kind == "weighted":
+        topo.set_edge_weight(
+            np.random.default_rng(5).random(topo.edge_count) + 0.1
+        )
+        kw["weighted"] = True
+    else:
+        topo.set_edge_time(np.random.default_rng(8).random(topo.edge_count))
+        kw["time_window"] = (0.2, 0.8)
+    mesh = make_mesh(n_devices=F, data=1, feature=F)
+    dist = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                            dedup="sort", topo_sharding="mesh", mesh=mesh,
+                            routed_alpha=0.25, **kw)
+    rep = GraphSageSampler(topo, [4, 3], seed=7, seed_capacity=32,
+                           dedup="sort", **kw)
+    seeds = np.random.default_rng(F).integers(0, topo.node_count,
+                                              32 * F - 3)
+    _assert_worker_parity(dist, rep, seeds, jax.random.PRNGKey(F))
+    assert int(np.asarray(dist.last_sample_overflow).sum()) > 0
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("F", [1, 2, 4])
 def test_dist_parity_other_mesh_widths(F):
@@ -164,13 +228,24 @@ def test_mesh_sharding_constructor_guards():
         GraphSageSampler(topo, [4], topo_sharding="mesh")
     with pytest.raises(ValueError, match="topo_sharding"):
         GraphSageSampler(topo, [4], topo_sharding="nope")
-    with pytest.raises(NotImplementedError, match="weighted"):
-        w = np.ones(topo.edge_count, np.float32)
-        t2 = _graph(n=200)
-        t2.set_edge_weight(w)
-        GraphSageSampler(t2, [4], topo_sharding="mesh", mesh=mesh,
+    # weighted over mesh is SUPPORTED now — but only when the topology
+    # actually carries weights (the shard partition needs cum_weights)
+    with pytest.raises(ValueError, match="requires edge weights"):
+        GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
                          weighted=True)
-    with pytest.raises(NotImplementedError, match="eid"):
+    w = np.ones(topo.edge_count, np.float32)
+    t2 = _graph(n=200)
+    t2.set_edge_weight(w)
+    assert isinstance(
+        GraphSageSampler(t2, [4], topo_sharding="mesh", mesh=mesh,
+                         weighted=True),
+        DistGraphSageSampler,
+    )
+    # temporal over mesh likewise needs timestamps on the topology
+    with pytest.raises(ValueError, match="requires edge timestamps"):
+        GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
+                         time_window=(0.0, 1.0))
+    with pytest.raises(ValueError, match="with_eid over a sharded"):
         GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
                          with_eid=True)
     with pytest.raises(ValueError, match="kernel"):

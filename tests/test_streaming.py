@@ -231,11 +231,108 @@ def test_verify_catches_untouched_corruption():
         verify_merged_csr(indptr, indices, new_ip, bad, touched, 1, 0)
 
 
-def test_weighted_topology_rejected():
-    topo, coo = _graph()
-    topo.set_edge_weight(np.ones(coo.shape[1]))
-    with pytest.raises(NotImplementedError, match="weighted"):
-        StreamingGraph(topo)
+def _attr_graph(n=50, e=200, seed=0):
+    rng = np.random.default_rng(seed)
+    ei = np.stack([rng.integers(0, n, e), rng.integers(0, n, e)])
+    topo = CSRTopo(edge_index=ei)
+    topo.set_edge_weight((rng.random(e) + 0.1))
+    topo.set_edge_time(rng.random(e))
+    return topo, ei
+
+
+def test_attributed_admission_named_rejections():
+    """Inserts into a weighted/timestamped topology must carry matching
+    attribute columns or the WHOLE batch is rejected with a named reason
+    and quarantined — a half-attributed commit would silently corrupt the
+    sampler's CDF/window searches."""
+    topo, _ = _attr_graph()
+    sg = StreamingGraph(topo)
+    ins = np.array([[1], [2]])
+    bad = [
+        (DeltaBatch(edge_inserts=ins), "missing-edge-weights"),
+        (DeltaBatch(edge_inserts=ins, edge_weights=np.array([1.0])),
+         "missing-edge-times"),
+        (DeltaBatch(edge_inserts=ins, edge_weights=np.array([-1.0]),
+                    edge_times=np.array([0.5])), "bad-edge-weights"),
+        (DeltaBatch(edge_inserts=ins, edge_weights=np.array([1.0]),
+                    edge_times=np.array([np.nan])), "bad-edge-times"),
+        (DeltaBatch(edge_inserts=ins, edge_weights=np.array([1.0, 2.0]),
+                    edge_times=np.array([0.5, 0.5])), "bad-edge-weights"),
+    ]
+    for delta, needle in bad:
+        assert sg.ingest(delta) is False
+        assert needle in sg.quarantined[-1].reason
+        assert sg.quarantined[-1].stage == "ingest"
+    assert not sg.staged
+    assert int(np.asarray(sg.metrics.value(DELTAS_QUARANTINED))) == len(bad)
+    assert topo.version == 0
+
+
+def test_attributed_commit_publishes_slot_aligned_attrs():
+    """A good attributed batch commits: inserted edges land with their
+    weights/timestamps slot-aligned, rows stay time-nondecreasing, and
+    the weight prefix sums re-derive over the merged slot order."""
+    from quiver_tpu.core.topology import _row_prefix_weights
+
+    topo, _ = _attr_graph()
+    E = topo.edge_count
+    sg = StreamingGraph(topo)
+    row = 7
+    dsrc, ddst = _first_live_edge(topo)
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=np.array([[row, row, 3], [11, 12, 13]]),
+        edge_weights=np.array([0.7, 0.9, 1.1]),
+        edge_times=np.array([0.05, 0.95, 0.4]),
+    )), sg.quarantined and sg.quarantined[-1].reason
+    assert sg.ingest(DeltaBatch(edge_deletes=np.array([[dsrc], [ddst]])))
+    assert sg.commit() is not None
+    ip, ix = np.asarray(topo.indptr), np.asarray(topo.indices)
+    wt, tm = np.asarray(topo.edge_weight), np.asarray(topo.edge_time)
+    assert wt.shape == ix.shape == tm.shape
+    assert int(ip[-1]) == E + 3 - 1
+    for r in range(topo.node_count):
+        assert (np.diff(tm[ip[r]:ip[r + 1]]) >= 0).all(), r
+    seg = slice(ip[row], ip[row + 1])
+    for d, dw, dt in [(11, 0.7, 0.05), (12, 0.9, 0.95)]:
+        pos = np.flatnonzero((ix[seg] == d) & np.isclose(tm[seg], dt))
+        assert pos.size == 1 and np.isclose(wt[seg][pos[0]], dw), d
+    assert np.array_equal(
+        np.asarray(topo.cum_weights),
+        _row_prefix_weights(wt.astype(np.float64), ip),
+    )
+    assert topo.version == 1
+
+
+def test_unattributed_topo_rejects_attr_deltas():
+    topo, _ = _graph()
+    sg = StreamingGraph(topo)
+    assert not sg.ingest(DeltaBatch(edge_inserts=np.array([[1], [2]]),
+                                    edge_weights=np.array([1.0])))
+    assert "unexpected-edge-weights" in sg.quarantined[-1].reason
+    assert not sg.ingest(DeltaBatch(edge_inserts=np.array([[1], [2]]),
+                                    edge_times=np.array([1.0])))
+    assert "unexpected-edge-times" in sg.quarantined[-1].reason
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array([[1], [2]])))
+    assert sg.commit() is not None
+
+
+def test_weighted_only_topo_streaming_flow():
+    """Weights-only topology: times rejected, weights required, and a
+    deletes-only batch needs no attribute columns at all."""
+    topo, ei = _graph(n=50, e=200)
+    topo.set_edge_weight(np.ones(200))
+    sg = StreamingGraph(topo)
+    assert not sg.ingest(DeltaBatch(
+        edge_inserts=np.array([[1], [2]]), edge_weights=np.array([1.0]),
+        edge_times=np.array([0.5])))
+    assert "unexpected-edge-times" in sg.quarantined[-1].reason
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array([[1], [2]]),
+                                edge_weights=np.array([2.5])))
+    assert sg.commit() is not None
+    assert np.asarray(topo.edge_weight).shape[0] == 201
+    s, d = _first_live_edge(topo)
+    assert sg.ingest(DeltaBatch(edge_deletes=np.array([[s], [d]])))
+    assert sg.commit() is not None
 
 
 # -- versioned invalidation --------------------------------------------------
