@@ -128,6 +128,14 @@ JOBS = [
      "interconnect lanes) in front of the capped routed sharded tier; "
      "per-tier hit rates + cap tightened by the measured L0 hit rate, "
      "effective lanes/hop = 2*L*(1-h0) vs the capped row's 2*L"),
+    ("feature-controller", "benchmarks.bench_feature",
+     ["--policy", "shard", "--routed", "--routed-alpha", "2",
+      "--replicate-budget", "16M", "--controller"],
+     "quiver-ctl replay: a recorded skewed trace (heat != degree) feeds "
+     "the frequency sketch, repin re-tiers L0 to the measured-hot rows, "
+     "and the record carries the measured L0 hit-rate delta vs the "
+     "static degree-prefix placement at the SAME budget plus the "
+     "audited JSONL decision-log path"),
     ("sampler-sharded", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--topo-sharding", "mesh", "--routed-alpha", "2"],
      "mesh-sharded topology: CSR partitioned over the feature axis "

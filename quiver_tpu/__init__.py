@@ -11,6 +11,7 @@ Top-level exports mirror the reference package surface
 (torch-quiver srcs/python/quiver/__init__.py:1-10).
 """
 
+from .control import AlphaTuner, CacheController, CostModel, FreqSketch, SplitTuner
 from .core.config import CachePolicy, SampleMode, parse_size_bytes
 from .datasets import GraphDataset, load_dataset, planted_partition
 from .core.hetero import HeteroCSRTopo, RelCSR
@@ -132,6 +133,11 @@ __all__ = [
     "DeadlineBatcher",
     "EmbeddingRefresher",
     "ServeQueueFull",
+    "AlphaTuner",
+    "CacheController",
+    "CostModel",
+    "FreqSketch",
+    "SplitTuner",
 ]
 
 __version__ = "0.1.0"

@@ -1,0 +1,41 @@
+"""quiver-ctl — telemetry-driven cache & routing control plane.
+
+Closes the loop from graftscope telemetry (tier hits, routed overflow,
+the in-program row-heat histogram, StepTimeline stage times) to the
+store's placement and routing knobs:
+
+* :mod:`~quiver_tpu.control.freq` — the measuring half: a traced
+  positional heat histogram riding the MetricsTape pytree plus an exact
+  host-side top-K heavy-hitter set, EMA-decayed between epochs;
+* :mod:`~quiver_tpu.control.cost` — an analytic cost model (predicted
+  lanes/hop and tier hit rates as a function of L0 split and
+  ``routed_alpha``) calibrated from measured StepTimeline stages, using
+  the same formulas the benchmarks emit;
+* :mod:`~quiver_tpu.control.controller` — :class:`CacheController`:
+  between-batch/epoch decisions with hysteresis and dead-bands that
+  re-tier L0 to the measured-hottest rows (``ShardedFeature.repin``),
+  move the L0/L1 boundary toward measured hit mass, and adjust
+  ``routed_alpha`` in BOTH directions — every decision audited as a
+  JSONL record through the obs exporters.
+
+The store's ``auto_split`` and the trainer's ``auto_alpha`` remain as
+thin compat shims delegating to a default controller; pass
+``DistributedTrainer(controller=...)`` / ``InferenceServer(controller=
+...)`` to share one across training and serving traffic.
+"""
+
+from .controller import AlphaTuner, CacheController, SplitTuner
+from .cost import CostModel, predicted_hit_rates, routed_lanes_per_hop
+from .freq import FreqSketch, heat_num_bins, row_heat_histogram
+
+__all__ = [
+    "AlphaTuner",
+    "CacheController",
+    "CostModel",
+    "FreqSketch",
+    "SplitTuner",
+    "heat_num_bins",
+    "predicted_hit_rates",
+    "routed_lanes_per_hop",
+    "row_heat_histogram",
+]

@@ -1,0 +1,197 @@
+"""quiver-ctl access-frequency sketch — measured heat over the row space.
+
+The reference's hot/cold placement is planned ONCE from node degree
+(utils.py:213-231 ``reindex_by_config``) — a static graph statistic that
+GNNSampler (arxiv 2108.11571) argues should be replaced by the *measured*
+access distribution of the running workload. This module is the measuring
+half of that loop, two complementary structures:
+
+* an **in-program positional histogram** (:func:`row_heat_histogram`):
+  every tiered-gather id lands one count in a bounded ``(num_bins,)``
+  vector binned over the store's TRANSLATED row order. Binning is
+  monotone in the translated index (bin = row // rows_per_bin), so the
+  cumulative mass below any candidate L0/L1 boundary reads straight off
+  the histogram — exactly the cost-model input
+  (:func:`~quiver_tpu.control.cost.predicted_hit_rates`). The vector
+  rides the trainer's MetricsTape pytree through ``shard_map`` /
+  ``epoch_scan`` (psum'd once per step like ``feature.tier_hits``) and
+  costs zero collectives when ``collect_metrics=False``.
+* an **exact top-K heavy-hitter set** (host side, SpaceSaving-style):
+  original node ids with estimated hit counts, fed from every
+  host-visible id stream — serve batches, eager gathers, replayed
+  traces, degree priors. This is what names the rows a
+  :meth:`~quiver_tpu.feature.shard.ShardedFeature.repin` pins into L0.
+
+Both decay with an EMA between epochs (:meth:`FreqSketch.decay`) so heat
+tracks the *current* traffic mix instead of the run's whole history.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["FreqSketch", "row_heat_histogram", "heat_num_bins"]
+
+
+def heat_num_bins(num_rows: int, num_bins: int = 256) -> int:
+    """The histogram width for an ``num_rows``-row store: ``num_bins``
+    capped at the row count (a 10-row toy store gets 10 exact bins, not
+    246 empty ones)."""
+    return max(1, min(int(num_bins), int(num_rows)))
+
+
+def row_heat_histogram(n_id, feature_order, num_rows: int, num_bins: int):
+    """Traced per-row access-heat histogram over the translated row space.
+
+    ``n_id`` are the gather's ORIGINAL node ids (-1 = invalid lane, the
+    tiered-gather padding convention — contributes nothing);
+    ``feature_order`` the store's node-id -> translated-row map (None =
+    identity). Bin ``b`` covers translated rows
+    ``[b * rpb, (b + 1) * rpb)`` with ``rpb = ceil(num_rows/num_bins)``
+    — positional, monotone binning, so prefix sums of the result are
+    exact hit masses below candidate tier boundaries. Returns int32
+    ``(num_bins,)``; callers inside ``shard_map`` psum it at the same
+    axes as their tier-hit vector.
+    """
+    n_id = jnp.asarray(n_id)
+    valid = n_id >= 0
+    ids = jnp.where(valid, n_id, 0)
+    if feature_order is not None:
+        ids = feature_order[ids]
+    rpb = -(-num_rows // num_bins)  # ceil; bins stay < num_bins
+    bins = jnp.clip(ids // rpb, 0, num_bins - 1)
+    return jnp.zeros((num_bins,), jnp.int32).at[bins].add(
+        valid.astype(jnp.int32)
+    )
+
+
+class FreqSketch:
+    """Host-side access-heat state: EMA'd positional histogram + exact
+    top-K heavy hitters.
+
+    Args:
+      num_rows: the store's row count (fixes the bin -> row mapping).
+      num_bins: histogram width (capped at ``num_rows``).
+      top_k: heavy-hitter capacity. SpaceSaving eviction: a new id
+        replaces the current minimum and inherits its count (classic
+        overestimate-never-underestimate guarantee), so the top of the
+        set is exact once an id is genuinely frequent.
+      decay: EMA factor applied by :meth:`decay` — ``heat *= decay`` —
+        so between-epoch heat tracks the current traffic mix.
+    """
+
+    def __init__(self, num_rows: int, num_bins: int = 256,
+                 top_k: int = 1024, decay: float = 0.5):
+        if num_rows < 1:
+            raise ValueError(f"num_rows must be >= 1, got {num_rows}")
+        if not 0.0 < decay <= 1.0:
+            raise ValueError(f"decay must be in (0, 1], got {decay}")
+        self.num_rows = int(num_rows)
+        self.num_bins = heat_num_bins(num_rows, num_bins)
+        self.rows_per_bin = -(-self.num_rows // self.num_bins)
+        self.top_k = int(top_k)
+        self.decay_factor = float(decay)
+        # EMA'd translated-bin heat (float64: the EMA makes counts fractional)
+        self.heat = np.zeros(self.num_bins, np.float64)
+        # heavy hitters: original node id -> estimated hit count
+        self._hitters: dict[int, float] = {}
+        self.observed = 0  # raw hits ever folded in (pre-decay)
+
+    # -- feeding -------------------------------------------------------------
+
+    def observe_histogram(self, hist) -> None:
+        """Fold one program-produced heat histogram in (``(num_bins,)``,
+        or an epoch_scan stack ``(steps, num_bins)`` — summed over
+        steps). This is the trainer-path feed: binned, translated-space,
+        no individual ids."""
+        arr = np.asarray(hist, np.float64)
+        if arr.ndim == 2:
+            arr = arr.sum(axis=0)
+        if arr.shape != (self.num_bins,):
+            raise ValueError(
+                f"histogram shape {arr.shape} != ({self.num_bins},)"
+            )
+        self.heat += arr
+        self.observed += int(arr.sum())
+
+    def observe_ids(self, ids, weight: float = 1.0) -> None:
+        """Fold a host-visible ORIGINAL-node-id stream in (serve batches,
+        eager gathers, replayed traces). Updates the heavy-hitter set;
+        the histogram is fed by the in-program path, not here (ids at
+        this boundary are pre-translation, and double-counting the
+        trainer's own gathers would skew the bins)."""
+        ids = np.asarray(ids).reshape(-1)
+        ids = ids[ids >= 0]
+        if ids.size == 0:
+            return
+        uniq, counts = np.unique(ids, return_counts=True)
+        self.observed += int(counts.sum())
+        for i, c in zip(uniq.tolist(), counts.tolist()):
+            self._bump(int(i), float(c) * weight)
+
+    def observe_prior(self, weights) -> None:
+        """Fold a per-node prior in — e.g. post-mutation degrees from the
+        streaming path's ``note_degree_update``. The prior seeds the
+        heavy-hitter set at LOW weight (one synthetic hit scaled by the
+        node's share of the total), so it breaks ties before traffic is
+        measured but measured heat quickly dominates it."""
+        w = np.asarray(weights, np.float64).reshape(-1)
+        if w.size == 0 or w.sum() <= 0:
+            return
+        top = np.argsort(-w, kind="stable")[: self.top_k]
+        scale = float(w[top].max())
+        for i in top.tolist():
+            if w[i] > 0:
+                self._bump(int(i), float(w[i]) / scale)
+
+    def _bump(self, node: int, weight: float) -> None:
+        if node in self._hitters:
+            self._hitters[node] += weight
+        elif len(self._hitters) < self.top_k:
+            self._hitters[node] = weight
+        else:
+            # SpaceSaving: evict the minimum, inherit its count
+            victim = min(self._hitters, key=self._hitters.__getitem__)
+            floor = self._hitters.pop(victim)
+            self._hitters[node] = floor + weight
+
+    # -- reading -------------------------------------------------------------
+
+    def top_rows(self, k: int) -> np.ndarray:
+        """The ``k`` hottest ORIGINAL node ids, hottest first (fewer when
+        fewer have been observed) — the row set a ``repin`` pins."""
+        items = sorted(
+            self._hitters.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+        return np.array([i for i, _ in items[:k]], np.int64)
+
+    def bin_mass_below(self, row: int) -> float:
+        """EMA'd hit mass at translated rows ``[0, row)`` — fractional
+        inside the boundary bin (uniform-within-bin assumption)."""
+        row = max(0, min(int(row), self.num_rows))
+        full, part = divmod(row, self.rows_per_bin)
+        mass = float(self.heat[:full].sum())
+        if part and full < self.num_bins:
+            mass += float(self.heat[full]) * part / self.rows_per_bin
+        return mass
+
+    @property
+    def total_mass(self) -> float:
+        return float(self.heat.sum())
+
+    def decay(self) -> None:
+        """Between-epoch EMA decay of both structures."""
+        self.heat *= self.decay_factor
+        for node in self._hitters:
+            self._hitters[node] *= self.decay_factor
+
+    def state(self) -> dict:
+        """Snapshot for audit records / tests (copies, not views)."""
+        return {
+            "num_bins": self.num_bins,
+            "observed": self.observed,
+            "total_mass": self.total_mass,
+            "hitters": dict(self._hitters),
+        }

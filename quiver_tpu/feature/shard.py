@@ -418,8 +418,11 @@ class ShardedFeature(KernelChoice):
     Per-tier hit counts of the last eager gather land in
     ``last_tier_hits`` (int32 ``(3,)`` device vector,
     ``[replicated, sharded, cold]``) — the measured hit distribution the
-    ``auto_split=True`` tuner uses to move the L0/L1 boundary between
-    batches (see :meth:`_maybe_auto_split`).
+    control plane uses to move the L0/L1 boundary between batches.
+    ``auto_split=True`` is a compat shim over a default
+    :class:`~quiver_tpu.control.CacheController` (see
+    :meth:`_maybe_auto_split`); attach a shared controller for measured
+    re-tiering (:meth:`repin`) across training AND serving traffic.
     """
 
     def __init__(
@@ -480,6 +483,13 @@ class ShardedFeature(KernelChoice):
         # bound version against this and raise instead of serving stale
         # rows (quiver_tpu.streaming's invalidation contract).
         self.version = 0
+        # quiver-ctl seam: the attached CacheController (None = standalone).
+        # auto_split=True lazily creates a default one on first tuner call;
+        # DistributedTrainer(controller=...) attaches a shared one. The
+        # split decision itself lives in control/controller.py — this class
+        # only measures (tier hits) and actuates (resplit/repin).
+        self._controller = None
+        self._resplit_from_tuner = False
 
     def _plan_split(self, n: int, f: int, itemsize: int, quantized: bool,
                     num_shards: int) -> tuple[int, int]:
@@ -666,6 +676,10 @@ class ShardedFeature(KernelChoice):
         # stale hits describe the OLD boundary; the tuner must not act on
         # them against the new one
         self.last_tier_hits = None
+        if self._controller is not None and not self._resplit_from_tuner:
+            # a MANUAL move invalidates the tuner's direction history (its
+            # own moves keep it — that history IS the reversal dead-band)
+            self._controller.split_tuner.reset()
 
     def replan(self, mesh: Mesh) -> "ShardedFeature":
         """Re-place the three-tier store onto a DIFFERENT mesh shape
@@ -750,6 +764,115 @@ class ShardedFeature(KernelChoice):
         rows = budget // max(row_bytes, 1)
         self._rep_ceiling_rows = max(self._rep_ceiling_rows, rows)
         self.resplit(rows)
+
+    def repin(self, rows) -> None:
+        """Re-tier the store so ``rows`` (ORIGINAL node ids, hottest
+        first) occupy the FRONT of the translated row space — a
+        measured-hottest set becomes the L0 prefix, spilling into L1 when
+        longer than ``rep_rows``. This is the quiver-ctl actuation seam:
+        the initial placement can only pin a degree-order prefix
+        (``reorder_by_degree``), whereas ``repin`` accepts ARBITRARY hot
+        sets (heat measured under real traffic need not correlate with
+        degree).
+
+        Tier SIZES are untouched; rows move WITH their bytes and dequant
+        scales, and ``feature_order`` is re-composed with the inverse
+        permutation, so every gather stays bitwise-identical — only the
+        comm path serving each row changes (the exactness contract of
+        :meth:`resplit`/:meth:`replan`). Duplicate ids keep their first
+        (hottest) occurrence; ids beyond ``rep_rows + hot_rows`` rows are
+        ignored (nothing to pin them into). Bumps ``version`` — compiled
+        consumers (the fused trainer's captured cold copy) must
+        ``refresh()``; :class:`~quiver_tpu.control.CacheController`
+        does this for its trainer automatically.
+        """
+        if self.shape is None:
+            raise ValueError("repin() before from_cpu_tensor()")
+        n, f = self.shape
+        device_rows = self.rep_rows + self.hot_rows
+        if device_rows == 0:
+            return  # cold-only store: no device tier to pin into
+        ids = np.asarray(rows).reshape(-1).astype(np.int64)
+        if ids.size == 0:
+            return
+        if ids.min() < 0 or ids.max() >= n:
+            raise ValueError(
+                f"repin ids must be in [0, {n}); got range "
+                f"[{ids.min()}, {ids.max()}]"
+            )
+        _, first = np.unique(ids, return_index=True)
+        ids = ids[np.sort(first)][:device_rows]
+        if self.feature_order is not None:
+            old_order = np.asarray(self.feature_order).astype(np.int64)
+            t = old_order[ids]
+        else:
+            old_order = None
+            t = ids
+        # permutation of the translated space: the pinned set first (in
+        # priority order), every other row keeping its relative order
+        mask = np.ones(n, bool)
+        mask[t] = False
+        perm = np.concatenate([t, np.nonzero(mask)[0]])
+        # reassemble the full translated table on host (replan's pattern:
+        # retained host region when available, else device read-back)
+        if self._region_host is not None:
+            region = self._region_host
+        else:
+            parts = []
+            if self.rep is not None:
+                parts.append(np.asarray(self.rep))
+            if self.hot is not None:
+                parts.append(np.asarray(self.hot.table)[: self.hot_rows])
+            region = (
+                np.concatenate(parts) if len(parts) > 1
+                else parts[0] if parts
+                else np.zeros((0, f), self.dtype)
+            )
+        full = (
+            np.concatenate([region, np.asarray(self.cold)])
+            if self.cold is not None else region
+        )
+        new_full = full[perm]
+        new_pos = np.empty(n, np.int64)
+        new_pos[perm] = np.arange(n, dtype=np.int64)
+        # compose: node id -> old translated row -> new translated row
+        new_order = new_pos if old_order is None else new_pos[old_order]
+        new_scale = (
+            None if self.scale is None else np.asarray(self.scale)[perm]
+        )
+        # --- publish: host state + ONE version bump, then re-place the
+        # device tiers from it (apply_row_updates' transaction shape) ---
+        self.version += 1
+        order_dtype = (
+            old_order.dtype if old_order is not None
+            else np.int32 if n <= np.iinfo(np.int32).max else np.int64
+        )
+        new_order = new_order.astype(order_dtype, copy=False)
+        self.feature_order = jnp.asarray(new_order)
+        if self.csr_topo is not None:
+            self.csr_topo.feature_order = new_order
+        if new_scale is not None:
+            self.scale = jnp.asarray(new_scale)
+        self._place_region(new_full[:device_rows], self.rep_rows)
+        if self.cold is not None:
+            old_cold = self.cold
+            self.cold, self._cold_is_host = to_pinned_host(
+                new_full[device_rows:], mesh=self.mesh
+            )
+            if hasattr(old_cold, "delete"):
+                old_cold.delete()
+        if self._region_host is not None:
+            self._region_host = np.ascontiguousarray(
+                new_full[:device_rows]
+            )
+        # pre-repin telemetry describes the OLD row order
+        self.last_tier_hits = None
+        get_logger("feature").info(
+            "repin v%d: %d measured-hot rows pinned to the front of the "
+            "device region (%d replicated / %d sharded rows; same bytes, "
+            "recomposed order — gathers stay bit-identical)",
+            self.version, ids.shape[0], self.rep_rows, self.hot_rows,
+        )
 
     # -- streaming mutation (transactional row updates) ----------------------
 
@@ -878,9 +1001,19 @@ class ShardedFeature(KernelChoice):
         synthetic per-tier "hit mass" vector — degree-as-heat, the
         proxy the store's initial placement used. One boundary move per
         commit, at most; measured traffic keeps tuning afterwards.
-        No-op unless ``auto_split=True`` (the tuner's own opt-in)."""
-        if self.shape is None or not self.auto_split \
-                or self._region_host is None:
+        No-op unless ``auto_split=True`` (the tuner's own opt-in).
+
+        With a :class:`~quiver_tpu.control.CacheController` attached the
+        new degrees additionally seed its frequency sketch as a PRIOR
+        (low weight — measured heat quickly dominates), so post-mutation
+        re-tiering and measured-traffic re-tiering share one state."""
+        if self.shape is None:
+            return
+        if self._controller is not None:
+            prior = np.asarray(degree).reshape(-1)
+            if prior.shape[0] == self.shape[0]:
+                self._controller.observe_prior(prior)
+        if not self.auto_split or self._region_host is None:
             return
         n, _ = self.shape
         degree = np.asarray(degree).reshape(-1)
@@ -905,50 +1038,50 @@ class ShardedFeature(KernelChoice):
 
     # graftlint: eager -- between-batch split tuner; under trace the hits
     def _maybe_auto_split(self) -> None:  # int() raises and except returns
-        """Move the L0/L1 boundary toward the measured hit distribution.
+        """Compat shim: feed the measured hit distribution to the
+        attached :class:`~quiver_tpu.control.CacheController`'s
+        :class:`~quiver_tpu.control.SplitTuner` and actuate its L0/L1
+        boundary decision (``auto_split=True`` lazily creates a default
+        controller on first call — the legacy opt-in keeps working with
+        no code change).
 
         Consumes ``last_tier_hits`` (the previous eager batch — long
-        completed, so the read is cheap). With h0/h1 the replicated/sharded
-        hit counts and dev = h0 + h1:
-
-        * **grow** (double ``rep_rows``, up to the budget ceiling) when
-          ``h1 > h0`` but L0 is clearly in the traffic (``h0 >= dev/8``):
-          the hit mass sits just beyond the boundary — pull it into the
-          zero-comm tier.
-        * **shrink** (halve) when ``h0 < dev/8``: the replicated rows are
-          not earning their F× HBM cost; hand them back to the sharded
-          tier (same rows covered, 1/F the per-device bytes).
-
-        The dead band between the two rules prevents oscillation; each
-        move is a factor of 2, one per batch, INFO-logged.
+        completed, so the read is cheap). The tuner's signals are the
+        rules this method used to hard-code — grow (double ``rep_rows``,
+        up to the budget ceiling) when the hit mass sits just beyond the
+        boundary, shrink (halve) when L0 is not earning its F× HBM —
+        plus a reversal dead-band so a noisy batch at the ceiling cannot
+        oscillate the boundary (see ``control/controller.py``).
         """
         hits = self.last_tier_hits
         if hits is None or self._region_host is None:
             return
+        ctl = self._controller
+        if ctl is None:
+            if not self.auto_split:
+                return
+            from ..control import CacheController  # lazy: no import cycle
+            ctl = CacheController.for_store(self)
         self.last_tier_hits = None
         try:
             h0, h1, _hc = (int(v) for v in np.asarray(hits))
         except Exception:  # noqa: BLE001 — a deleted/donated buffer must
             return  # not break the next gather
-        dev = h0 + h1
-        if dev <= 0:
-            return
         total = self._region_host.shape[0]
         ceiling = min(self._rep_ceiling_rows, total)
-        new = None
-        why = ""
-        if h0 * 8 < dev and self.rep_rows > 0:
-            new, why = self.rep_rows // 2, "L0 under-hit"
-        elif h1 > h0 and 0 < self.rep_rows < ceiling:
-            new, why = min(self.rep_rows * 2, ceiling), "hit mass beyond L0"
+        new = ctl.decide_split(h0, h1, self.rep_rows, ceiling)
         if new is None or new == self.rep_rows:
             return
         get_logger("feature").info(
-            "auto-split: %s (L0 %d vs sharded %d hits); moving "
+            "auto-split: L0 %d vs sharded %d hits; moving "
             "replicated/sharded boundary %d -> %d rows",
-            why, h0, h1, self.rep_rows, new,
+            h0, h1, self.rep_rows, new,
         )
-        self.resplit(new)
+        self._resplit_from_tuner = True
+        try:
+            self.resplit(new)
+        finally:
+            self._resplit_from_tuner = False
 
     def delete(self) -> None:
         """Free all tier buffers now (reference ``shard_tensor.delete``)."""
@@ -990,7 +1123,7 @@ class ShardedFeature(KernelChoice):
         batch's per-tier hit counts (int32 (3,)); with ``auto_split=True``
         the measured distribution moves the L0/L1 boundary before the next
         batch (:meth:`_maybe_auto_split`)."""
-        if self.auto_split:
+        if self.auto_split or self._controller is not None:
             self._maybe_auto_split()
         rep_gather = (
             None if self.rep is None

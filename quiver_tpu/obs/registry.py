@@ -63,6 +63,11 @@ __all__ = [
     "SERVE_RECOMPILES",
     "TRAIN_OVERLAP_EFFICIENCY",
     "PIPELINE_REISSUES",
+    "FEATURE_ROW_HEAT",
+    "CTRL_DECISIONS",
+    "CTRL_REPINS",
+    "CTRL_SPLIT_MOVES",
+    "CTRL_ALPHA_CHANGES",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -113,6 +118,18 @@ SERVE_RECOMPILES = "serve.recompiles"
 # sample+gather)
 TRAIN_OVERLAP_EFFICIENCY = "train.overlap_efficiency"
 PIPELINE_REISSUES = "train.pipeline_reissues"
+# control plane (quiver_tpu/control): the in-program per-row access-heat
+# histogram (positional bins over the store's translated row order, psum'd
+# once per step like feature.tier_hits; opt-in — registered only when a
+# controller asks for it so controller-off telemetry is untouched), and the
+# host-side decision counters every CacheController audit record increments:
+# total decisions emitted, L0 repins to a measured hot set, L0/L1 boundary
+# moves, and routed_alpha changes (grow OR shrink)
+FEATURE_ROW_HEAT = "feature.row_heat"
+CTRL_DECISIONS = "ctrl.decisions"
+CTRL_REPINS = "ctrl.repins"
+CTRL_SPLIT_MOVES = "ctrl.split_moves"
+CTRL_ALPHA_CHANGES = "ctrl.alpha_changes"
 
 _KINDS = ("counter", "gauge")
 

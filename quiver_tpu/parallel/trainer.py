@@ -39,7 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.config import parse_size_bytes
 from ..feature.feature import Feature
 from ..feature.shard import ShardedFeature
+from ..control.freq import heat_num_bins, row_heat_histogram
 from ..obs.registry import (
+    FEATURE_ROW_HEAT,
     GUARD_NONFINITE,
     GUARD_SKIPPED,
     PIPELINE_REISSUES,
@@ -151,6 +153,18 @@ class DistributedTrainer:
         ``train.pipeline_reissues``) so chunk state never needs to
         serialize the in-flight batch. Affects epoch_scan only; step()
         stays the fused serial program.
+      controller: a :class:`~quiver_tpu.control.CacheController` that
+        owns the store's placement/routing decisions. The trainer
+        attaches it to a ShardedFeature (L0/L1 boundary moves + measured
+        ``repin`` re-tiering), registers its in-program row-heat
+        histogram feed (``feature.row_heat`` — rides the metrics pytree,
+        zero-cost when ``collect_metrics=False``), delegates the shared
+        ``routed_alpha`` tuning to it, and drives its epoch hooks from
+        :meth:`epoch_scan`. ``auto_alpha=True`` with no controller is a
+        compat shim: a default alpha-only controller is created (grow on
+        overflow as before, PLUS shrink on sustained slack). A frozen
+        controller observes without deciding — the step program and
+        trajectory stay bitwise those of ``controller=None``.
     """
 
     def __init__(
@@ -173,6 +187,7 @@ class DistributedTrainer:
         checkpoint_keep: int = 3,
         logical_workers: int | None = None,
         pipeline_depth: int = 0,
+        controller=None,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -213,9 +228,10 @@ class DistributedTrainer:
         # one routing budget for the whole step: the SAME routed_alpha caps
         # the sharded-feature gather buckets AND (for a topo_sharding="mesh"
         # sampler) the per-hop neighbor-routing buckets. auto_alpha=True
-        # turns on the shared tuner: after an eager batch whose feature OR
-        # sampler routing overflowed (fallback-served — exact, just extra
-        # comm), alpha doubles (capped at F) and the step retraces.
+        # turns on the shared tuner (a default control.CacheController —
+        # see _maybe_grow_routed_alpha): overflow from an eager batch
+        # doubles alpha (capped at F), sustained slack shrinks it back
+        # (floor-bounded, no oscillation), and either change retraces.
         self.auto_alpha = bool(auto_alpha)
         # graftscope (obs/): ONE registry serves every telemetry stream the
         # step program produces. The traced body feeds a MetricsTape, the
@@ -440,6 +456,37 @@ class DistributedTrainer:
             self.workers = self._device_workers
         self.blocks_per_device = self.workers // self._device_workers
         self.global_batch = self.local_batch * self.workers
+        # quiver-ctl (control/): one controller owns the placement and
+        # routing decisions the legacy flags delegate to. auto_alpha with
+        # no controller builds an alpha-only default (heat_bins=0, NOT
+        # attached to the store — it must not start moving a split the
+        # user never opted into); an explicit controller is attached to a
+        # ShardedFeature and gets the in-program heat feed when it asks
+        # for one (registered HERE, before the program builds, so the
+        # histogram rides the step's metrics pytree).
+        if controller is None and self.auto_alpha:
+            from ..control import CacheController
+
+            controller = CacheController(heat_bins=0)
+        elif controller is not None and isinstance(feature, ShardedFeature):
+            controller.attach(feature)
+        self.controller = controller
+        if (
+            controller is not None
+            and controller.wants_heat
+            and self.collect_metrics
+            and isinstance(feature, ShardedFeature)
+            and feature.shape
+        ):
+            self.metrics.gauge(
+                FEATURE_ROW_HEAT,
+                shape=(heat_num_bins(feature.shape[0],
+                                     controller.heat_bins),),
+                unit="hits",
+                doc="in-program per-row access-heat histogram (positional "
+                    "bins over the store's translated row order, "
+                    "mesh-total; feeds the controller's FreqSketch)",
+            )
         _, self.caps = sampler._compiled(self.local_batch)
         self._step = self._build()
         self._epoch_fn = self._build_epoch()
@@ -615,6 +662,13 @@ class DistributedTrainer:
         rows_per_shard = (
             sampler.topo.rows_per_shard if topo_sharded else 0
         )
+        # in-program heat feed: compiled in ONLY when a controller
+        # registered feature.row_heat (so a controller-off program is
+        # byte-for-byte the baseline, like the guard counters)
+        heat_on = metrics.enabled and FEATURE_ROW_HEAT in metrics.names()
+        heat_bins = (
+            metrics.spec(FEATURE_ROW_HEAT).shape[0] if heat_on else 0
+        )
 
         def gather_features(parts, n_id):
             """Three-tier gather; returns (rows, routed_overflow_count,
@@ -673,7 +727,11 @@ class DistributedTrainer:
                 rep_rows=rep_rows, rep_gather=rep_g,
                 hot_miss_id=-1 if sharded else 0, with_hits=True,
             )
-            return x, ov_box[0], hits
+            heat = (
+                row_heat_histogram(n_id, order, node_count, heat_bins)
+                if heat_on else None
+            )
+            return x, ov_box[0], hits, heat
 
         elastic = self.elastic
         bpd = self.blocks_per_device
@@ -712,8 +770,9 @@ class DistributedTrainer:
                     dedup=sampler.dedup,
                 )
                 sample_ov = jnp.zeros((len(sizes),), jnp.int32)
-            x, routed_ov, tier_hits = gather_features(parts, n_id)
-            return n_id, x, adjs, num_seeds, routed_ov, tier_hits, sample_ov
+            x, routed_ov, tier_hits, heat = gather_features(parts, n_id)
+            return (n_id, x, adjs, num_seeds, routed_ov, tier_hits,
+                    sample_ov, heat)
 
         def train_block(params, n_id, x, adjs, num_seeds, labels, key,
                         inject):
@@ -752,13 +811,12 @@ class DistributedTrainer:
             # one logical seed block = the two halves composed in place
             # (the serial schedule; pipeline_depth=1 runs the same halves
             # as separate programs with a one-step skew between them)
-            n_id, x, adjs, num_seeds, routed_ov, tier_hits, sample_ov = (
-                issue_block(topo, parts, seeds, key)
-            )
+            (n_id, x, adjs, num_seeds, routed_ov, tier_hits, sample_ov,
+             heat) = issue_block(topo, parts, seeds, key)
             loss, grads = train_block(
                 params, n_id, x, adjs, num_seeds, labels, key, inject
             )
-            return loss, grads, routed_ov, tier_hits, sample_ov
+            return loss, grads, routed_ov, tier_hits, sample_ov, heat
 
         # the step program's metric names, split by producing half: the
         # issue half owns the sample/gather telemetry, the train half the
@@ -767,7 +825,9 @@ class DistributedTrainer:
         # train.pipeline_reissues never enter the program), the pipelined
         # halves finalize their own subset so the merged per-step dict is
         # disjoint instead of zero-filled entries clobbering real values.
-        issue_names = (ROUTED_OVERFLOW, TIER_HITS, SAMPLE_OVERFLOW)
+        issue_names = (ROUTED_OVERFLOW, TIER_HITS, SAMPLE_OVERFLOW) + (
+            (FEATURE_ROW_HEAT,) if heat_on else ()
+        )
         train_names = (GUARD_SKIPPED, GUARD_NONFINITE) if guard else ()
         program_names = issue_names + train_names
 
@@ -782,7 +842,8 @@ class DistributedTrainer:
                 )
             axes = (DATA_AXIS, FEATURE_AXIS)
             if not elastic:
-                loss, grads, routed_ov, tier_hits, sample_ov = one_block(
+                (loss, grads, routed_ov, tier_hits, sample_ov,
+                 heat) = one_block(
                     params, topo, parts, seeds, labels,
                     jax.random.fold_in(key, widx), inject
                 )
@@ -818,6 +879,7 @@ class DistributedTrainer:
                 routed_ov = sum(o[2] for o in outs)
                 tier_hits = sum(o[3] for o in outs)
                 sample_ov = sum(o[4] for o in outs)
+                heat = sum(o[5] for o in outs) if heat_on else None
                 if guard:
                     # stacked per-block values: one verdict for the whole
                     # step, still counted before any cross-worker mean
@@ -838,6 +900,11 @@ class DistributedTrainer:
             tape.add(ROUTED_OVERFLOW, routed_ov, psum=DATA_AXIS)
             tape.set(TIER_HITS, tier_hits,
                      psum=axes if routed else DATA_AXIS)
+            if heat_on:
+                # same reduction discipline as tier_hits: distinct lanes
+                # per device under "all", redundant under "data"
+                tape.set(FEATURE_ROW_HEAT, heat,
+                         psum=axes if routed else DATA_AXIS)
             if topo_sharded:
                 tape.add(SAMPLE_OVERFLOW, sample_ov, psum=DATA_AXIS)
             if guard:
@@ -922,6 +989,7 @@ class DistributedTrainer:
             routed_ov = sum(o[4] for o in outs)
             tier_hits = sum(o[5] for o in outs)
             sample_ov = sum(o[6] for o in outs)
+            heat = sum(o[7] for o in outs) if heat_on else None
             # identical feeds (and psum axes) to the serial body — the
             # issue half owns the batch's telemetry so a carried batch's
             # metrics stay attributed to the step that SAMPLED it
@@ -929,6 +997,9 @@ class DistributedTrainer:
             tape.add(ROUTED_OVERFLOW, routed_ov, psum=DATA_AXIS)
             tape.set(TIER_HITS, tier_hits,
                      psum=axes if routed else DATA_AXIS)
+            if heat_on:
+                tape.set(FEATURE_ROW_HEAT, heat,
+                         psum=axes if routed else DATA_AXIS)
             if topo_sharded:
                 tape.add(SAMPLE_OVERFLOW, sample_ov, psum=DATA_AXIS)
             return PipelinedBatch(
@@ -1104,10 +1175,19 @@ class DistributedTrainer:
         step_idx = self._fault_step
         self._fault_step += 1
         with self.timeline.stage("step"):
-            if isinstance(feature, ShardedFeature) and feature.auto_split:
+            if isinstance(feature, ShardedFeature) and (
+                feature.auto_split
+                or getattr(feature, "_controller", None) is not None
+            ):
                 feature._maybe_auto_split()
             self._maybe_grow_routed_alpha()
             packed = self.shard_seeds(seeds)
+            if self.controller is not None:
+                # seeds are the host-visible slice of the step's gather
+                # traffic — feed the controller's heavy-hitter set (the
+                # in-program histogram covers the full id stream, but
+                # only host-visible ids can NAME rows for a repin)
+                self.controller.observe_ids(packed)
             packed = jax.device_put(
                 jnp.asarray(packed),
                 NamedSharding(self.mesh, self._seed_spec()),
@@ -1124,6 +1204,10 @@ class DistributedTrainer:
             # hand the batch totals to the store so its eager split tuner
             # sees the fused path's traffic too
             feature.last_tier_hits = mtree[TIER_HITS]
+        if mtree and self.controller is not None:
+            # fold the step's heat histogram into the controller's sketch
+            # (no-op when the heat feed is off)
+            self.controller.observe_histogram(mtree.get(FEATURE_ROW_HEAT))
         if (plan is not None and not self._preempt_fired
                 and plan.preempts_in(step_idx, step_idx + 1)):
             # the step ran but its results are lost with the raise — the
@@ -1301,7 +1385,17 @@ class DistributedTrainer:
         losses_parts: list = []
         mtrees_parts: list = []
         with self.timeline.stage("epoch_scan"):
+            if isinstance(self.feature, ShardedFeature) and getattr(
+                    self.feature, "_controller", None) is not None:
+                # actuate any pending split decision between epochs (the
+                # legacy auto_split flag only ever consumed hits via
+                # step()/gather(); a controller tunes the scanned path too)
+                self.feature._maybe_auto_split()
             self._maybe_grow_routed_alpha()
+            if self.controller is not None:
+                # the epoch's seed matrix is its host-visible id stream
+                # (see step(): only host-visible ids can name repin rows)
+                self.controller.observe_ids(np.asarray(seed_mat))
             packed = jax.device_put(
                 jnp.asarray(seed_mat),
                 NamedSharding(self.mesh, P(None, *self._seed_spec())),
@@ -1362,6 +1456,22 @@ class DistributedTrainer:
         else:  # start == steps: a resumed, already-finished epoch
             losses, mtrees = jnp.zeros((0,), jnp.float32), {}
         self.metrics.record(mtrees)
+        if self.controller is not None:
+            # epoch-boundary controller hooks: fold the epoch's stacked
+            # heat into the sketch, hand the epoch's tier-hit totals to
+            # the store's split shim, then let the controller consider a
+            # measured-hot repin and decay its sketch
+            if mtrees:
+                self.controller.observe_histogram(
+                    mtrees.get(FEATURE_ROW_HEAT)
+                )
+                if isinstance(self.feature, ShardedFeature) and \
+                        TIER_HITS in mtrees:
+                    self.feature.last_tier_hits = np.asarray(
+                        mtrees[TIER_HITS]
+                    ).sum(axis=0)
+            if isinstance(self.feature, ShardedFeature):
+                self.controller.end_epoch(self.feature, self)
         return params, opt_state, losses
 
     # -- checkpoint / auto-resume -------------------------------------------
@@ -1565,17 +1675,20 @@ class DistributedTrainer:
 
     # graftlint: eager -- between-batch tuner on host numpy telemetry; the
     def _maybe_grow_routed_alpha(self) -> None:  # step program never calls it
-        """Shared eager routing tuner (``auto_alpha=True``): the sampler's
-        per-hop routing and the feature gather draw on ONE budget, so one
-        tuner reads both overflow telemetries. If the PREVIOUS eager batch
-        fallback-served any lanes (feature ``last_routed_overflow`` or
-        sampler ``last_sample_overflow``), double ``routed_alpha`` (capped
-        at F — full-length buckets) and rebuild the step program. Overflow
-        lanes were served exactly, so this only trades one retrace for less
-        fallback comm on later batches."""
-        if not self.auto_alpha or self.routed_alpha is None:
-            return
-        if self.routed_alpha >= self.feature_size:
+        """Shared eager routing tuner (compat shim over the controller's
+        :class:`~quiver_tpu.control.AlphaTuner`): the sampler's per-hop
+        routing and the feature gather draw on ONE budget, so one tuner
+        reads both overflow telemetries. Overflow from the PREVIOUS eager
+        batch doubles ``routed_alpha`` (capped at F — full-length
+        buckets) as it always did; sustained SLACK (consecutive clean
+        batches) now also shrinks it, bounded by a floor the tuner raises
+        whenever a shrink is immediately punished, so a transient skew
+        burst no longer inflates comm for the rest of the run. Either
+        change rebuilds the step program (one retrace); overflow lanes
+        were served exactly either way. ``auto_alpha=True`` builds the
+        default controller this delegates to; pass ``controller=`` to
+        share one with the split/repin decisions."""
+        if self.controller is None or self.routed_alpha is None:
             return
         total = 0
         for v in (self.last_routed_overflow, self.last_sample_overflow):
@@ -1585,15 +1698,18 @@ class DistributedTrainer:
                 total += int(np.asarray(v).sum())
             except Exception:  # noqa: BLE001 — a deleted/donated buffer
                 continue  # must not break the next step
-        if total <= 0:
+        new = self.controller.decide_alpha(
+            total, self.routed_alpha, float(self.feature_size)
+        )
+        if new is None:
             return
         old = self.routed_alpha
-        self.routed_alpha = min(old * 2.0, float(self.feature_size))
+        self.routed_alpha = float(new)
         from ..utils.trace import get_logger
 
         get_logger().info(
             "shared routing budget: %d lanes fallback-served last batch "
-            "(feature gather + sampler hops); growing alpha %.2f -> %.2f "
+            "(feature gather + sampler hops); alpha %.2f -> %.2f "
             "(one retrace)",
             total, old, self.routed_alpha,
         )

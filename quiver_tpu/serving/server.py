@@ -76,6 +76,13 @@ class InferenceServer:
         rows instead of failing requests.
       breaker_failures / probe_every: breaker thresholds when wrapping.
       metrics / timeline: external graftscope sinks (private by default).
+      controller: optional :class:`~quiver_tpu.control.CacheController`
+        to feed serve-path gather frequencies into — every served
+        batch's sampled node ids fold into the SAME sketch the training
+        loop feeds, so the store can re-tier under serving traffic
+        (``controller.end_epoch(store)`` between serving windows, then
+        :meth:`refresh` if a repin bumped the version). Attached to the
+        underlying store when it is a ``ShardedFeature``.
     """
 
     STAGES = ("queue_wait", "pad", "sample", "gather", "forward", "readback")
@@ -88,7 +95,8 @@ class InferenceServer:
                  degraded: str | None = None, breaker_failures: int = 3,
                  probe_every: int = 8,
                  metrics: MetricsRegistry | None = None,
-                 timeline: StepTimeline | None = None):
+                 timeline: StepTimeline | None = None,
+                 controller=None):
         self.sampler = sampler
         self.model = model
         self.params = params
@@ -101,6 +109,15 @@ class InferenceServer:
                 fallback=degraded, metrics=self.metrics,
             )
         self.feature = feature
+        self.controller = controller
+        if controller is not None:
+            # the underlying store (unwrapping the breaker) is where
+            # repin decisions land; plain Feature stores still feed the
+            # sketch but have no tiers to move
+            store = feature.feature if isinstance(feature, DegradedFeature) \
+                else feature
+            if hasattr(store, "_controller"):
+                controller.attach(store)
         self.batcher = DeadlineBatcher(
             buckets=tuple(buckets) if buckets else ladder_buckets(max_batch),
             default_deadline_s=default_deadline_s,
@@ -224,6 +241,16 @@ class InferenceServer:
             self.pump(force=True)
         return reqs
 
+    @staticmethod
+    def _host_rows(rows):
+        # a mesh-sharded store's gather comes back with a multi-device
+        # NamedSharding; the ladder executables are AOT-compiled for
+        # single-device inputs, so de-shard before feeding forward
+        sharding = getattr(rows, "sharding", None)
+        if sharding is not None and len(sharding.device_set) > 1:
+            return np.asarray(rows)
+        return rows
+
     def _run_batch(self, reqs, bucket: int) -> list[ServeRequest]:
         capL = self._ladder.lane_caps[-1]
         with self.timeline.stage("pad"):
@@ -243,8 +270,12 @@ class InferenceServer:
                 self.sampler.topo, seeds_d, nvalid_d, seqs_d, self._base_key
             )
             jax.block_until_ready(n_ids)
+        if self.controller is not None:
+            # serve-path gather frequencies feed the same sketch the
+            # training loop does (padding -1 lanes are filtered there)
+            self.controller.observe_serve(np.asarray(n_ids).reshape(-1))
         with self.timeline.stage("gather"):
-            rows = self.feature[n_ids.reshape(-1)]
+            rows = self._host_rows(self.feature[n_ids.reshape(-1)])
             x = jnp.asarray(rows, self._row_dtype).reshape(
                 bucket, capL, self._feature_dim
             )
@@ -291,7 +322,7 @@ class InferenceServer:
         n_id, eis, _overflow = self._ladder.oracle_sample(
             self.sampler.topo, node, seq, self._base_key
         )
-        rows = self.feature[n_id]
+        rows = self._host_rows(self.feature[n_id])
         x = jnp.asarray(rows, self._row_dtype).reshape(
             self._ladder.lane_caps[-1], self._feature_dim
         )

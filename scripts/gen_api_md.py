@@ -55,6 +55,8 @@ SECTIONS = [
     ("quiver_tpu.serving",
      "Online inference serving — deadline-aware micro-batching over "
      "AOT-compiled ladder programs"),
+    ("quiver_tpu.control",
+     "quiver-ctl — telemetry-driven cache & routing control plane"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),

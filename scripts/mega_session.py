@@ -89,6 +89,7 @@ ORDER = [
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),
     ("feature-threetier", 900),
+    ("feature-controller", 900),
     ("sampler-sharded", 900),
     ("sampler-hetero-sharded", 900),
     ("acceptance", 1800),

@@ -1,0 +1,439 @@
+"""quiver-ctl control plane (quiver_tpu/control): differential tests.
+
+Fast lane: two-sided tuner units with the no-oscillation regressions
+(the legacy tuners' one-sided failure modes, pinned on a constant
+workload), the frozen-decision bitwise-parity differential (an attached-
+but-frozen controller must not change one bit of the loss trajectory,
+params, or telemetry), repin-vs-dense-oracle exactness (f32 AND int8),
+and the audited JSONL decision trail.
+
+Slow lane: the skewed-trace placement differential (heat != degree —
+measured-frequency L0 placement must beat the degree prefix at the same
+budget) and the serve re-tier drill (serving traffic feeds the same
+sketch, a repin re-tiers under the live server, and controller state
+survives a streaming commit + refresh()).
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from quiver_tpu import (
+    AlphaTuner,
+    CacheController,
+    CSRTopo,
+    DeltaBatch,
+    GraphSageSampler,
+    InferenceServer,
+    SplitTuner,
+    StreamingGraph,
+    VersionMismatchError,
+)
+from quiver_tpu.control.cost import CostModel, routed_lanes_per_hop
+from quiver_tpu.control.freq import FreqSketch
+from quiver_tpu.feature.shard import ShardedFeature
+from quiver_tpu.models.sage import GraphSAGE
+from quiver_tpu.obs.export import read_jsonl
+from quiver_tpu.obs.registry import (
+    CTRL_ALPHA_CHANGES,
+    CTRL_DECISIONS,
+    ROUTED_OVERFLOW,
+    TIER_HITS,
+)
+from quiver_tpu.parallel.mesh import make_mesh
+from quiver_tpu.parallel.trainer import DistributedTrainer
+
+
+def _graph(n=400, e=3000, seed=5):
+    rng = np.random.default_rng(seed)
+    ei = np.stack([rng.integers(0, n, e), rng.integers(0, n, e)])
+    return CSRTopo(edge_index=ei)
+
+
+def _oracle(feat, ids):
+    ref = feat[np.where(ids >= 0, ids, 0)].copy()
+    ref[ids < 0] = 0
+    return ref
+
+
+ROW_B = 8 * 4  # float32 rows, dim 8
+
+
+# -- tuner units: two-sided + no-oscillation ---------------------------------
+
+
+def test_alpha_tuner_grows_and_shrinks():
+    t = AlphaTuner(shrink_after=2, floor=0.25)
+    # grow doubles on overflow, capped at the feature-axis ceiling
+    assert t.decide(overflow=5, alpha=1.0, ceiling=4.0) == 2.0
+    assert t.decide(overflow=5, alpha=4.0, ceiling=4.0) is None
+    # the legacy tuner stopped here: alpha never came back down.
+    # Sustained slack now halves it, bounded by the floor.
+    assert t.decide(0, 4.0, 4.0) is None   # 1 clean batch: not yet
+    assert t.decide(0, 4.0, 4.0) == 2.0    # 2 consecutive: shrink
+    assert t.decide(0, 2.0, 4.0) is None
+    assert t.decide(0, 2.0, 4.0) == 1.0
+    assert t.decide(0, 0.5, 4.0) is None
+    assert t.decide(0, 0.5, 4.0) == 0.25   # the floor itself is reachable
+    assert t.decide(0, 0.25, 4.0) is None
+    assert t.decide(0, 0.25, 4.0) is None  # below the floor: never
+
+
+def test_alpha_tuner_no_oscillation_on_constant_workload():
+    """A punished shrink raises the floor: a CONSTANT workload with
+    intermittent overflow converges to a fixed alpha instead of cycling
+    shrink/regrow forever (the naive two-sided tuner's failure mode)."""
+    t = AlphaTuner(shrink_after=2, floor=0.25)
+    alpha, trace = 2.0, []
+    # overflow fires whenever alpha dips below the workload's true need
+    for _ in range(12):
+        overflow = 5 if alpha < 2.0 else 0
+        new = t.decide(overflow, alpha, ceiling=8.0)
+        if new is not None:
+            alpha = new
+        trace.append(alpha)
+    # one probe shrink (2 -> 1), punished, floor pinned at 2 — the tail
+    # must be flat at the converged value with no further probes
+    assert t.floor == 2.0
+    assert trace[-6:] == [2.0] * 6, trace
+    assert trace.count(1.0) == 1  # exactly one punished probe, ever
+
+
+def test_split_tuner_reversal_dead_band():
+    t = SplitTuner(confirm=2)
+    grow = dict(h0=10, h1=20)     # hit mass just beyond the boundary
+    shrink = dict(h0=1, h1=100)   # L0 serving under 1/8 of device hits
+    assert t.decide(rep_rows=16, ceiling=64, **shrink) == 8
+    # same direction stays immediate
+    assert t.decide(rep_rows=8, ceiling=64, **shrink) == 4
+    # reversal (grow after shrink) needs the signal twice in a row
+    assert t.decide(**grow, rep_rows=4, ceiling=64) is None
+    assert t.decide(**grow, rep_rows=4, ceiling=64) == 8
+    # a lone noisy batch between confirmations resets the pending count
+    assert t.decide(h0=1, h1=100, rep_rows=8, ceiling=64) is None  # 1st
+    assert t.decide(h0=50, h1=50, rep_rows=8, ceiling=64) is None  # calm
+    assert t.decide(h0=1, h1=100, rep_rows=8, ceiling=64) is None  # 1st again
+    assert t.decide(h0=1, h1=100, rep_rows=8, ceiling=64) == 4     # 2nd
+    # reset() forgets direction history (manual resplit)
+    t.reset()
+    assert t.decide(**grow, rep_rows=4, ceiling=64) == 8  # immediate again
+
+
+def test_split_tuner_no_oscillation_at_budget_ceiling():
+    """The legacy rule pair could alternate grow/shrink every batch on a
+    workload sitting near the h1 == h0 edge at the ceiling; the reversal
+    dead-band caps direction changes on a CONSTANT alternating signal."""
+    t = SplitTuner(confirm=2)
+    rep, moves = 32, []
+    for i in range(12):
+        h0, h1 = (1, 100) if i % 2 == 0 else (8, 10)  # shrink / grow sig
+        new = t.decide(h0, h1, rep, ceiling=64)
+        if new is not None:
+            moves.append((rep, new))
+            rep = new
+    # alternating signals never confirm a reversal: after the first
+    # shrink run the boundary is monotone down, not ping-ponging
+    assert all(b < a for a, b in moves), moves
+
+
+def test_cost_model_lanes_and_calibration():
+    m = routed_lanes_per_hop(local_len=96, num_shards=4, alpha=2.0)
+    assert m["cap"] == 48 and m["lanes_per_hop"] == 192
+    assert m["lanes_per_hop_uncapped"] == 384
+    # measured L0 hit rate tightens the planned cap
+    tighter = routed_lanes_per_hop(96, 4, 2.0, h0=0.5)
+    assert tighter["cap"] == 24
+    sk = FreqSketch(400, num_bins=100)  # 4 rows per bin
+    hist = np.zeros(100, np.int64)
+    hist[:10] = 5  # all heat mass on translated rows [0, 40)
+    sk.observe_histogram(hist)
+    cm = CostModel(local_len=96, num_shards=4)
+    out = cm.predict(sk, rep_rows=40, hot_rows=100, alpha=2.0)
+    assert out["hit_rep"] == pytest.approx(1.0)
+    assert "est_step_s" not in out  # not calibrated yet
+
+
+# -- frozen-decision bitwise parity ------------------------------------------
+
+
+def _trainer_run(controller):
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 4, 400)
+    feat = np.eye(4, dtype=np.float32)[labels] * 2.0
+    feat += rng.normal(scale=0.8, size=(400, 4)).astype(np.float32)
+    ei = np.stack([rng.integers(0, 400, 4000), rng.integers(0, 400, 4000)])
+    topo = CSRTopo(edge_index=ei)
+    n = topo.node_count
+    mesh = make_mesh(data=2, feature=4)
+    feature = ShardedFeature(
+        mesh, device_cache_size="1G", csr_topo=CSRTopo(edge_index=ei),
+        replicate_budget=64 * 4 * 4,
+    ).from_cpu_tensor(feat[:n])
+    trainer = DistributedTrainer(
+        mesh, GraphSageSampler(topo, [5, 5], seed=3), feature,
+        GraphSAGE(hidden=16, num_classes=4, num_layers=2),
+        optax.adam(5e-3), local_batch=32, seed_sharding="all",
+        routed_alpha=1.0, controller=controller,
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    labels_dev = jnp.asarray(labels[:n].astype(np.int32))
+    srng = np.random.default_rng(0)
+    losses = []
+    for step in range(3):
+        seeds = srng.integers(0, n, trainer.global_batch)
+        params, opt, loss = trainer.step(
+            params, opt, seeds, labels_dev, jax.random.PRNGKey(step)
+        )
+        losses.append(float(loss))
+    telemetry = {
+        name: np.asarray(trainer.metrics.snapshot(name).numpy)
+        for name in (ROUTED_OVERFLOW, TIER_HITS)
+    }
+    return losses, params, telemetry
+
+
+def test_frozen_controller_bitwise_parity():
+    """An attached-but-frozen controller observes everything and decides
+    nothing: loss trajectory, final params, and the standard telemetry
+    must be BITWISE identical to running with no controller at all."""
+    base_losses, base_params, base_tel = _trainer_run(None)
+    ctl = CacheController(frozen=True)
+    losses, params, tel = _trainer_run(ctl)
+    assert losses == base_losses
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        base_params, params,
+    )
+    for name in base_tel:
+        np.testing.assert_array_equal(base_tel[name], tel[name])
+    # it DID observe (heat histogram + host-visible seed ids)...
+    assert ctl.sketch is not None and ctl.sketch.total_mass > 0
+    assert ctl.stats()["observed"] > 0
+    # ...and decided nothing
+    assert ctl.stats()["decisions"] == 0 and not ctl.decisions
+
+
+# -- repin vs the dense oracle -----------------------------------------------
+
+
+def test_repin_matches_dense_oracle_f32():
+    """An arbitrary (non-degree) hot set repinned into L0: tier sizes
+    unchanged, pinned rows at the front of the translated space, and both
+    gather paths still bitwise equal to the dense numpy oracle."""
+    topo = _graph()
+    n = topo.node_count
+    feat = np.random.default_rng(1).normal(size=(n, 8)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(
+        mesh, device_cache_size=(n // 4 // 4) * ROW_B, csr_topo=topo,
+        replicate_budget=16 * ROW_B,
+    ).from_cpu_tensor(feat)
+    assert store.rep_rows == 16
+    rng = np.random.default_rng(2)
+    hot = rng.choice(n, 40, replace=False)  # arbitrary, not degree-sorted
+    rows = np.concatenate([hot, hot[:5]])   # dups keep first occurrence
+    sizes = (store.rep_rows, store.hot_rows)
+    v0 = store.version
+    store.repin(rows)
+    assert store.version == v0 + 1
+    assert (store.rep_rows, store.hot_rows) == sizes  # membership only
+    order = np.asarray(store.feature_order)
+    np.testing.assert_array_equal(order[hot], np.arange(hot.size))
+    ids = rng.integers(0, n, 96).astype(np.int32)
+    ids[:4] = -1
+    ref = _oracle(feat, ids)
+    assert np.array_equal(np.asarray(store[jnp.asarray(ids)]), ref)
+    assert np.array_equal(
+        np.asarray(store.gather(jnp.asarray(ids), routed=True)), ref
+    )
+    with pytest.raises(ValueError):
+        store.repin([n])  # out-of-range ids must not silently drop
+
+
+def test_repin_matches_dense_oracle_int8():
+    """int8: rows move WITH their dequant scales, so a repin must not
+    change a single output bit of the dequantized gathers."""
+    topo = _graph(n=300, e=2000, seed=8)
+    n = topo.node_count
+    feat = np.random.default_rng(8).normal(size=(n, 16)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(
+        mesh, device_cache_size="4K", csr_topo=topo, dtype="int8",
+        replicate_budget=16 * 16,
+    ).from_cpu_tensor(feat)
+    assert store.rep_rows > 0
+    ids = np.random.default_rng(9).integers(0, n, 64).astype(np.int32)
+    before = np.asarray(store[jnp.asarray(ids)])
+    hot = np.unique(ids)[:32][::-1].copy()  # reversed: genuinely re-ordered
+    store.repin(hot)
+    after = np.asarray(store[jnp.asarray(ids)])
+    routed = np.asarray(store.gather(jnp.asarray(ids), routed=True))
+    assert np.array_equal(after, before)
+    assert np.array_equal(routed, before)
+
+
+# -- audited decisions -------------------------------------------------------
+
+
+def test_decision_audit_jsonl_round_trip(tmp_path):
+    log = tmp_path / "decisions.jsonl"
+    ctl = CacheController(decision_log=str(log))
+    assert ctl.decide_alpha(overflow=7, alpha=1.0, ceiling=4.0) == 2.0
+    for _ in range(3):
+        assert ctl.decide_alpha(0, 2.0, 4.0) is None  # inside the band
+    assert ctl.decide_alpha(0, 2.0, 4.0) == 1.0  # 4 consecutive clean
+    recs = read_jsonl(str(log))
+    assert [r.name for r in recs] == [CTRL_ALPHA_CHANGES] * 2
+    lines = [json.loads(s) for s in log.read_text().splitlines()]
+    assert lines[0]["decision"] == "alpha"
+    assert lines[0]["direction"] == "grow" and lines[1]["direction"] == "shrink"
+    assert ctl.stats()["alpha_changes"] == 2 and ctl.stats()["decisions"] == 2
+    assert ctl.metrics.snapshot(CTRL_DECISIONS).last() == 2
+
+
+def test_streaming_degree_prior_feeds_controller():
+    """note_degree_update (the PR 8 streaming hook) lands in the attached
+    controller's sketch as a prior instead of dead-ending in the legacy
+    auto-split region cache."""
+    topo = _graph(n=200, e=1200, seed=3)
+    mesh = make_mesh(data=2, feature=4)
+    feat = np.random.default_rng(3).normal(size=(200, 8)).astype(np.float32)
+    store = ShardedFeature(
+        mesh, device_cache_size="1M", csr_topo=topo,
+        replicate_budget=8 * ROW_B,
+    ).from_cpu_tensor(feat)
+    ctl = CacheController().attach(store)
+    assert store._controller is ctl and ctl.sketch is not None
+    assert not ctl.sketch.state()["hitters"]
+    store.note_degree_update(np.arange(200, dtype=np.int64))
+    hitters = ctl.sketch.state()["hitters"]
+    assert hitters and max(hitters) == 199  # top-degree ids seeded
+
+
+# -- skewed-trace placement differential (slow) ------------------------------
+
+
+@pytest.mark.slow
+def test_measured_placement_beats_degree_prefix_on_skewed_trace():
+    """heat != degree: when the traffic concentrates on LOW-degree rows,
+    the controller's measured-frequency repin must serve strictly more of
+    the trace from L0 than the static degree-prefix placement at the SAME
+    replicate budget — the tentpole's headline claim."""
+    topo = _graph(n=400, e=3000, seed=5)
+    n = topo.node_count
+    feat = np.random.default_rng(0).normal(size=(n, 8)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    rep_rows = 32
+    store = ShardedFeature(
+        mesh, device_cache_size="1M", csr_topo=topo,
+        replicate_budget=rep_rows * ROW_B,
+    ).from_cpu_tensor(feat)
+    assert store.rep_rows == rep_rows
+    # hot set = the LOWEST-degree rows: the degree prefix can't see it
+    cold_by_degree = np.argsort(topo.degree.astype(np.int64),
+                                kind="stable")[:rep_rows]
+    rng = np.random.default_rng(7)
+    trace = rng.choice(cold_by_degree, size=4000).astype(np.int64)
+    trace = np.concatenate([trace, rng.integers(0, n, 1000)])  # 20% noise
+
+    def l0_hits(s):
+        return int((np.asarray(s.feature_order)[trace] < s.rep_rows).sum())
+
+    static = l0_hits(store)  # degree-prefix placement
+    ctl = CacheController().attach(store)
+    ctl.observe_ids(trace)
+    assert ctl.maybe_repin(store) is True
+    measured = l0_hits(store)
+    assert measured > static, (measured, static)
+    # the measured placement catches essentially the whole skewed mass
+    assert measured >= int(0.75 * trace.size)
+    assert ctl.stats()["repins"] == 1
+    # exactness survives the re-tier
+    ids = rng.integers(0, n, 96).astype(np.int32)
+    assert np.array_equal(np.asarray(store[jnp.asarray(ids)]),
+                          _oracle(feat, ids))
+
+
+# -- serve re-tier drill (slow) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_retier_drill_and_state_survives_commit():
+    """Serving traffic feeds the SAME sketch: a skewed serve workload
+    re-tiers the live store (responses stay oracle-exact across the
+    repin), and the controller's host-side state survives a streaming
+    commit + refresh() untouched."""
+    from quiver_tpu.parallel.train import empty_adjs, init_model
+
+    topo = _graph(n=240, e=1600, seed=4)
+    n = topo.node_count
+    dim = 8
+    feat = np.random.default_rng(4).normal(size=(n, dim)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    rep_rows = 16
+    store = ShardedFeature(
+        mesh, device_cache_size="1M", csr_topo=topo,
+        replicate_budget=rep_rows * dim * 4,
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [4, 3], seed=1)
+    model = GraphSAGE(hidden=16, num_classes=5, num_layers=2)
+    adjs = empty_adjs([4, 3], batch=4, node_count=n)
+    params = init_model(
+        model, jax.random.PRNGKey(1),
+        np.zeros((adjs[0].size[0], dim), np.float32), adjs,
+    )
+    # the seam under test is serve -> sketch -> repin + state survival,
+    # so the drill disables the other two knobs' dynamics: boundary
+    # moves are held (the cold-skewed trace would legitimately shrink
+    # the unearning L0 away before the epoch-end repin gets its turn)
+    # and the hysteresis band is dropped (the sampled NEIGHBOR traffic
+    # dilutes the seed skew; the dead-band default is unit-tested above)
+    class HeldSplit(SplitTuner):
+        def decide(self, *a, **k):
+            return None
+
+    ctl = CacheController(repin_min_gain=0.005, split_tuner=HeldSplit())
+    server = InferenceServer(sampler, model, params, store, max_batch=4,
+                             seed=3, controller=ctl)
+    assert store._controller is ctl  # attached through the server
+    server.warmup()
+    # hammer FOUR lowest-degree nodes in every batch: they reach the
+    # maximum per-batch count while the degree-hot neighbors cannot
+    cold = np.argsort(topo.degree.astype(np.int64), kind="stable")[:4]
+    nodes = np.tile(cold, 24)
+    before = server.serve(nodes[:8])
+    for r in before:
+        np.testing.assert_array_equal(r.result, server.oracle(r.node, r.seq))
+    server.serve(nodes[8:])
+    assert ctl.sketch.observed > 0
+    # epoch boundary: the serve-fed sketch re-tiers the store
+    v0 = store.version
+    ctl.end_epoch(store)
+    assert ctl.stats()["repins"] == 1 and store.version == v0 + 1
+    # feature reads are live per batch: serving continues, oracle-exact
+    after = server.serve(nodes[:8])
+    for r in after:
+        np.testing.assert_array_equal(r.result, server.oracle(r.node, r.seq))
+    # streaming commit -> stale ladder -> refresh(); controller state
+    # (sketch mass, decision trail) is host-side and survives untouched
+    observed, decisions = ctl.sketch.observed, list(ctl.decisions)
+    sg = StreamingGraph(topo)
+    src = np.repeat(np.arange(n), topo.degree)
+    dst = np.asarray(topo.indices)[: src.size]
+    live = set((src * n + dst).tolist())
+    k = next(k for k in range(n * n) if k not in live)
+    assert sg.ingest(DeltaBatch(edge_inserts=np.array([[k // n], [k % n]])))
+    sg.commit()
+    with pytest.raises(VersionMismatchError):
+        server.pump(force=True)
+    server.refresh()
+    assert ctl.sketch.observed == observed
+    assert ctl.decisions == decisions
+    final = server.serve(nodes[:4])
+    for r in final:
+        np.testing.assert_array_equal(r.result, server.oracle(r.node, r.seq))
