@@ -128,8 +128,12 @@ def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
     S = seeds.shape[0]
     valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
     s = jnp.where(valid, seeds, 0)
-    base = topo.indptr[s]  # keep indptr dtype: values can exceed int32 ranges
-    deg = (topo.indptr[s + 1] - base).astype(jnp.int32)
+    # jnp view of indptr: a host-numpy indptr indexed by a traced ``s``
+    # raises TracerArrayConversionError, so the windowed path silently
+    # lost its jit/lowering story (caught by graftaudit's pallas target)
+    indptr = jnp.asarray(topo.indptr)
+    base = indptr[s]  # keep indptr dtype: values can exceed int32 ranges
+    deg = (indptr[s + 1] - base).astype(jnp.int32)
     deg = jnp.where(valid, deg, 0)
 
     kr, kj, kw = jax.random.split(key, 3)

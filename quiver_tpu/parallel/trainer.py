@@ -27,6 +27,7 @@ feature > 1 (docs/Introduction.md "Cost of redundant sampling").
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -188,6 +189,7 @@ class DistributedTrainer:
         logical_workers: int | None = None,
         pipeline_depth: int = 0,
         controller=None,
+        donate_epoch_state: bool = False,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -303,6 +305,19 @@ class DistributedTrainer:
                 f"pipeline_depth must be 0 (serial) or 1 (one-step skew), "
                 f"got {pipeline_depth}"
             )
+        # donate_epoch_state=True marks the (params, opt_state) arguments
+        # of the epoch program as donated: XLA reuses the incoming leaves
+        # for the scan carry instead of double-buffering them, halving the
+        # model-state HBM footprint of epoch_scan. CONSUME semantics — the
+        # arrays the caller passes in are deleted after the call (on every
+        # backend, including CPU), so it is opt-in: the differential tests
+        # reuse their initial params across variants and must keep the
+        # default. epoch_scan itself is donation-safe — its chunk loop
+        # rebinds (params, opt_state) from each chunk's outputs. graftaudit
+        # (tools/audit, donation-audit rule) verifies the claim on the
+        # lowered IR: exactly the params+opt leaves carry donation attrs
+        # and the trace emits no unused-donation warning.
+        self.donate_epoch_state = bool(donate_epoch_state)
         self._pipeline_reissues = 0
         if self.pipeline_depth:
             self.metrics.counter(
@@ -1250,7 +1265,9 @@ class DistributedTrainer:
         # checkpoint-chunked epoch and a resumed one consume exactly the
         # slices an unchunked scan would have drawn: bit-identical keys
         # regardless of where the chunk/resume boundaries fall
-        @jax.jit
+        donate = (0, 1) if self.donate_epoch_state else ()
+
+        @partial(jax.jit, donate_argnums=donate)
         def fn(params, opt_state, topo, parts, seed_mat, labels, keys,
                inject_vec):
             def body(carry, xs):
@@ -1291,8 +1308,9 @@ class DistributedTrainer:
         """
         issue = self._issue
         train = self._train
+        donate = (0, 1) if self.donate_epoch_state else ()
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate)
         def fn(params, opt_state, topo, parts, seed_mat, labels, keys,
                inject_vec):
             first = issue(topo, parts, seed_mat[0], keys[0])

@@ -126,7 +126,10 @@ class ServeLadder:
 
     # -- bucket programs -----------------------------------------------------
 
-    def _build_sample(self, bucket: int):
+    def trace_sample(self, bucket: int):
+        """AOT-trace one bucket's sample program (no compile, no device
+        work) — the shared front half of :meth:`_build_sample`, also the
+        artifact graftaudit (``tools/audit``) walks."""
         def run(topo, seeds, nvalid, seqs, base_key):
             def lane(_, xs):
                 seed, nv, seq = xs
@@ -140,13 +143,16 @@ class ServeLadder:
         key = jax.ShapeDtypeStruct(
             jnp.shape(self.sampler._key), jnp.asarray(self.sampler._key).dtype
         )
-        compiled = (
-            jax.jit(run).lower(self.sampler.topo, shp, shp, shp, key).compile()
-        )
-        self._note_compile()
-        return compiled
+        return jax.jit(run).trace(self.sampler.topo, shp, shp, shp, key)
 
-    def _build_forward(self, bucket: int):
+    def trace_forward(self, bucket: int):
+        """AOT-trace one bucket's forward program against the bound
+        parameter structure. The gathered feature block is deliberately
+        NOT donated: ``(bucket, lane_cap, F)`` rows can never alias the
+        ``(bucket, classes)`` logits, so a ``donate_argnums=0`` here is an
+        unusable donation — pure warning noise at every bucket compile and
+        a standing invitation to believe memory is being saved when none
+        is (graftaudit's donation-audit rule flags exactly this)."""
         def run(x, edge_indices, params):
             def lane(_, xs):
                 xb, eis = xs
@@ -165,11 +171,15 @@ class ServeLadder:
         params = self._params_struct
         if params is None:
             raise RuntimeError("call bind_params() before compiling forward")
-        # donate the gathered feature block — the one large per-batch
-        # buffer, dead after the forward
-        compiled = (
-            jax.jit(run, donate_argnums=0).lower(x, eis, params).compile()
-        )
+        return jax.jit(run).trace(x, eis, params)
+
+    def _build_sample(self, bucket: int):
+        compiled = self.trace_sample(bucket).lower().compile()
+        self._note_compile()
+        return compiled
+
+    def _build_forward(self, bucket: int):
+        compiled = self.trace_forward(bucket).lower().compile()
         self._note_compile()
         return compiled
 

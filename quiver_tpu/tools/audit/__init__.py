@@ -1,0 +1,55 @@
+"""graftaudit — static analysis over *lowered* jax programs.
+
+graftlint (``tools/lint``) reads source; graftaudit reads what XLA will
+actually run. It AOT-traces the registry of canonical programs
+(``audit_targets.py`` — routed gather, tiered lookup, sample hop, epoch
+bodies, serve ladder, metrics pair, Pallas kernels) under
+``JAX_PLATFORMS=cpu`` with no device execution, then walks the
+jaxpr/StableHLO to machine-check the repo's compiled-program invariants,
+one rule family per established discipline:
+
+* ``collective-parity`` — cond branches share one collective schedule,
+  or the predicate is reduced over the branches' axes (PR 1/3).
+* ``metrics-strip`` — ``collect_metrics=False`` strips exactly the
+  declared metric reductions and nothing else moves (PR 5).
+* ``donation-audit`` — programs donate exactly the buffers they claim;
+  unusable-donation warnings are findings (PR 11/12).
+* ``dtype-discipline`` — no f64 leakage; int8 codes ride the routed
+  all_to_all un-upcast (PR 4).
+* ``constant-bloat`` — no large closure-folded constants (PR 11).
+* ``comm-budget`` — lowered epoch all_to_all lanes ==
+  ``control/cost.routed_lanes_per_hop`` exactly (PR 6/8).
+
+CLI: ``python -m quiver_tpu.tools.audit`` (``--json``, ``--sarif PATH``,
+``--select``/``--ignore`` rules or families, ``--targets``,
+``--changed BASE``, ``--list-rules``, ``--list-targets``; exit 0 clean /
+1 findings / 2 usage). Waivers are registry-side: a ``Target``
+declaration carries its reasoned exemptions, since an IR finding has no
+source line for an inline comment.
+
+This module imports no jax at import time, so the CLI can pin
+``XLA_FLAGS``/``JAX_PLATFORMS`` before the backend initializes; builders
+import jax lazily when a target is traced.
+"""
+
+from .audit_targets import REGISTRY, Built, Target, build, build_from
+from .cli import main
+from .rules import FAMILIES, RULES, family_of, rule_docs
+from .runner import AuditResult, changed_files, run_audit, select_targets
+
+__all__ = [
+    "AuditResult",
+    "Built",
+    "FAMILIES",
+    "REGISTRY",
+    "RULES",
+    "Target",
+    "build",
+    "build_from",
+    "changed_files",
+    "family_of",
+    "main",
+    "rule_docs",
+    "run_audit",
+    "select_targets",
+]

@@ -1,0 +1,300 @@
+"""jaxpr/StableHLO walking utilities for graftaudit.
+
+Everything here operates on *already traced* artifacts — a
+``jax.core.ClosedJaxpr`` (from ``jax.jit(fn).trace(...)``) and the
+lowered StableHLO text (``.lower().as_text()``). Nothing executes on a
+device; the only JAX dependency is the ``ClosedJaxpr``/``Jaxpr`` types
+for recursion.
+
+Primitive-name notes (pinned against the in-repo jax):
+
+* ``psum`` appears as ``psum2`` inside ``shard_map`` bodies (the
+  replication-tracking rewrite); both names are reductions here.
+* ``pbroadcast`` is a replication *cast*, not communication — never
+  counted as a collective.
+* ``lax.cond`` is the ``cond`` primitive; per-branch programs live in
+  ``eqn.params["branches"]`` as ClosedJaxprs.
+* donation shows up in the lowered text as ``tf.aliasing_output`` on
+  inputs jax pre-aliased to an output, or ``jax.buffer_donor`` on donated
+  inputs whose pairing is deferred to XLA (scan-carried state lowers this
+  way). An UNUSABLE donation leaves NO attr at all — jax only reports it
+  as a trace/lower-time warning, which the target builders capture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+__all__ = [
+    "COLLECTIVES",
+    "REDUCTIONS",
+    "Collective",
+    "iter_eqns",
+    "collectives_of",
+    "conds_of",
+    "branch_collectives",
+    "predicate_axis_reduced",
+    "main_arg_attrs",
+    "iter_consts",
+    "f64_eqns",
+]
+
+# comm primitives (jaxpr names). psum2 / all_gather_invariant are the
+# shard_map-internal spellings; reduce_scatter is psum_scatter's lowering.
+REDUCTIONS = frozenset({
+    "psum", "psum2", "psum_invariant", "pmin", "pmax", "pmean",
+})
+COLLECTIVES = REDUCTIONS | frozenset({
+    "all_to_all", "all_gather", "all_gather_invariant", "ppermute",
+    "pshuffle", "reduce_scatter", "psum_scatter",
+})
+
+
+def _jaxpr_of(obj):
+    """Unwrap ClosedJaxpr -> Jaxpr; pass raw Jaxpr through; else None."""
+    eqns = getattr(obj, "eqns", None)
+    if eqns is not None:
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and getattr(inner, "eqns", None) is not None:
+        return inner
+    return None
+
+
+def _sub_jaxprs(eqn):
+    """(param_key, index, Jaxpr) for every sub-program an eqn carries
+    (pjit/shard_map ``jaxpr``, cond ``branches``, scan/while bodies,
+    custom_* call jaxprs, ...) — keyed generically off the params so new
+    primitives keep working."""
+    for key, val in eqn.params.items():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for i, sub in enumerate(vals):
+            j = _jaxpr_of(sub)
+            if j is not None:
+                yield key, i, j
+
+
+def iter_eqns(jaxpr, path=()):
+    """Yield ``(eqn, path)`` over a jaxpr and all nested sub-jaxprs.
+    ``path`` is a tuple of ``"prim"``/``"prim[i]"`` hops — e.g.
+    ``("pjit", "shard_map", "cond[1]")`` — used to print *where* in the
+    program a finding sits."""
+    j = _jaxpr_of(jaxpr)
+    if j is None:
+        return
+    for eqn in j.eqns:
+        yield eqn, path
+        for key, i, sub in _sub_jaxprs(eqn):
+            hop = (f"{eqn.primitive.name}[{i}]"
+                   if eqn.primitive.name == "cond" else eqn.primitive.name)
+            yield from iter_eqns(sub, path + (hop,))
+
+
+def _axes_of(eqn) -> tuple:
+    """Normalized mesh-axis names of a collective eqn."""
+    for key in ("axis_name", "axes", "axis"):
+        if key in eqn.params:
+            ax = eqn.params[key]
+            if isinstance(ax, (tuple, list)):
+                return tuple(str(a) for a in ax)
+            return (str(ax),)
+    return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One comm op in a lowered program, as the rules compare them."""
+    prim: str
+    axes: tuple
+    shape: tuple
+    dtype: str
+    path: tuple = dataclasses.field(default=(), compare=False)
+
+    @property
+    def lanes(self) -> int:
+        """Leading-two-dims product — the bucket-lane count of a routed
+        ``all_to_all`` operand shaped ``(F, cap, ...)``."""
+        if len(self.shape) >= 2:
+            return int(self.shape[0]) * int(self.shape[1])
+        return int(self.shape[0]) if self.shape else 1
+
+    def signature(self):
+        """Identity used for multiset comparison across programs."""
+        return (self.prim, self.axes, self.shape, self.dtype)
+
+    def __str__(self):
+        loc = "/".join(self.path) or "top"
+        return (f"{self.prim}[{','.join(self.axes)}] "
+                f"{self.dtype}{list(self.shape)} @ {loc}")
+
+
+def _as_collective(eqn, path) -> Collective:
+    v = eqn.invars[0]
+    aval = v.aval
+    return Collective(
+        prim=eqn.primitive.name,
+        axes=_axes_of(eqn),
+        shape=tuple(getattr(aval, "shape", ())),
+        dtype=str(getattr(aval, "dtype", "?")),
+        path=path,
+    )
+
+
+def collectives_of(jaxpr, include_paths=True) -> list:
+    """Ordered collectives of a program (nested programs included)."""
+    out = []
+    for eqn, path in iter_eqns(jaxpr):
+        if eqn.primitive.name in COLLECTIVES:
+            out.append(_as_collective(eqn, path if include_paths else ()))
+    return out
+
+
+def conds_of(jaxpr) -> list:
+    """``(cond_eqn, enclosing_jaxpr, path)`` for every ``cond``. The
+    enclosing jaxpr is kept so predicate provenance can be sliced in the
+    scope the predicate variable is defined in."""
+    out = []
+
+    def _walk(j, path):
+        j = _jaxpr_of(j)
+        if j is None:
+            return
+        for eqn in j.eqns:
+            if eqn.primitive.name == "cond":
+                out.append((eqn, j, path))
+            for key, i, sub in _sub_jaxprs(eqn):
+                hop = (f"{eqn.primitive.name}[{i}]"
+                       if eqn.primitive.name == "cond"
+                       else eqn.primitive.name)
+                _walk(sub, path + (hop,))
+
+    _walk(jaxpr, ())
+    return out
+
+
+def branch_collectives(cond_eqn) -> list:
+    """Per-branch ordered collective lists of a ``cond`` eqn."""
+    return [collectives_of(br) for br in cond_eqn.params["branches"]]
+
+
+def predicate_axis_reduced(cond_eqn, enclosing_jaxpr, axes) -> bool:
+    """Is the cond predicate provably uniform across ``axes``?
+
+    Backward slice from the predicate variable inside its defining scope:
+    True when the slice passes through a reduction collective
+    (psum/pmin/pmax/...) whose axis set covers ``axes`` — the repo's
+    psum-fallback discipline (``parallel/routing.py``: the fallback cond's
+    predicate is the axis-psum of the overflow count, so every axis member
+    takes the same branch and the collectives inside cannot desync).
+    In-slice nested calls (pjit wrappers around jnp ops) are scanned
+    transitively. A predicate whose provenance leaves the scope (a scope
+    input) is NOT provably reduced — callers treat that as a finding when
+    the branches' collectives differ.
+    """
+    need = set(axes)
+    if not need:
+        return True
+    defmap = {}
+    for eqn in enclosing_jaxpr.eqns:
+        for ov in eqn.outvars:
+            defmap[ov] = eqn
+    seen = set()
+    stack = [cond_eqn.invars[0]]
+    while stack:
+        v = stack.pop()
+        # Literals carry .val and define nothing; they are also unhashable
+        if id(v) in seen or not hasattr(v, "aval") or hasattr(v, "val"):
+            continue
+        seen.add(id(v))
+        eqn = defmap.get(v)
+        if eqn is None:
+            continue  # literal, const, or scope input — not reduced here
+        if eqn.primitive.name in REDUCTIONS and need <= set(_axes_of(eqn)):
+            return True
+        # an in-slice call (pjit etc.): a covering reduction anywhere
+        # inside reduces every output of the call
+        for _k, _i, sub in _sub_jaxprs(eqn):
+            for inner, _p in iter_eqns(sub):
+                if (inner.primitive.name in REDUCTIONS
+                        and need <= set(_axes_of(inner))):
+                    return True
+        stack.extend(eqn.invars)
+    return False
+
+
+# -- StableHLO text helpers ---------------------------------------------------
+
+_MAIN_RE = re.compile(r"func\.func\s+(?:public\s+)?@main\((.*?)\)\s*->",
+                      re.DOTALL)
+
+
+def _split_top_level(s: str) -> list:
+    """Split an MLIR argument list on top-level commas (respects nesting
+    of ``<>``, ``{}``, ``()`` and ``[]`` inside type/attr expressions)."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "<{([":
+            depth += 1
+        elif ch in ">})]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        parts.append(tail)
+    return parts
+
+
+def main_arg_attrs(mlir_text: str) -> list:
+    """Per-argument donation facts of ``@main``: a list (one dict per
+    flattened argument, in order) of ``{"aliased": bool, "donor": bool}``.
+    ``aliased`` = jax wired the input to an output buffer at lowering
+    (``tf.aliasing_output``); ``donor`` = donated with the buffer pairing
+    deferred to XLA (``jax.buffer_donor``). Either attr counts as the
+    donation being real; a donated arg with NEITHER never lowered at all
+    (unusable donations surface only as build warnings)."""
+    m = _MAIN_RE.search(mlir_text)
+    if m is None:
+        return []
+    out = []
+    for arg in _split_top_level(m.group(1)):
+        out.append({
+            "aliased": "tf.aliasing_output" in arg,
+            "donor": "jax.buffer_donor" in arg,
+        })
+    return out
+
+
+def iter_consts(closed_jaxpr, path=()):
+    """Yield ``(const, path)`` for every constant captured by the program
+    or any nested sub-program (closure-folded arrays land here)."""
+    for c in getattr(closed_jaxpr, "consts", ()) or ():
+        yield c, path
+    j = _jaxpr_of(closed_jaxpr)
+    if j is None:
+        return
+    for eqn in j.eqns:
+        for key, i, sub in _sub_jaxprs(eqn):
+            # recurse through the *closed* object when the param holds one
+            # (its consts are what we are after), else the raw jaxpr
+            vals = eqn.params[key]
+            vals = vals if isinstance(vals, (tuple, list)) else (vals,)
+            closed = vals[i]
+            yield from iter_consts(closed, path + (eqn.primitive.name,))
+
+
+def f64_eqns(jaxpr) -> list:
+    """``(eqn, aval, path)`` wherever a float64/complex128 value is
+    produced — the f64-leak detector (the repo runs x64-disabled; any
+    wide float in a lowered program is an upcast bug or a config leak)."""
+    out = []
+    for eqn, path in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            dt = str(getattr(v.aval, "dtype", ""))
+            if dt in ("float64", "complex128"):
+                out.append((eqn, v.aval, path))
+    return out

@@ -1,0 +1,279 @@
+"""graftaudit rules — invariants checked on *lowered* programs.
+
+Each rule is a function ``(target, built, registry_builds) -> [Finding]``
+over one :class:`~quiver_tpu.tools.audit.audit_targets.Built` artifact
+(``registry_builds`` resolves a paired target, e.g. the metrics on/off
+differential). Findings reuse graftlint's :class:`Finding` shape so both
+tools share SARIF plumbing; the path is the target's primary source file
+(the program is lowered FROM it) and the message names the target.
+
+Rule families and the PR whose discipline they machine-check:
+
+* parity (collective-parity) — PR 1/3: psum-fallback conds keep both
+  branches on one collective schedule, or reduce their predicate.
+* metrics (metrics-strip) — PR 5: ``collect_metrics=False`` strips every
+  metric collective from the compiled step.
+* donation (donation-audit) — PR 11/12: programs donate the buffers they
+  claim to, and nothing they don't.
+* dtype (dtype-discipline) — PR 4: no f64 leakage; int8 tier codes ride
+  the wire un-upcast.
+* constants (constant-bloat) — PR 11: no large closure-folded arrays
+  (an HBM + recompile hazard for AOT ladders).
+* comm (comm-budget) — PR 6/8: the lowered epoch body's all_to_all lanes
+  equal ``control/cost.routed_lanes_per_hop`` exactly.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ..lint.rules import Finding
+from . import ir
+
+__all__ = ["FAMILIES", "RULES", "family_of", "rule_docs"]
+
+# closure-folded constants above this ride every program version through
+# the compile cache; targets can tighten/loosen via meta["const_bytes_limit"]
+CONST_BYTES_LIMIT = 1 << 20
+
+
+def _finding(rule, target, message) -> Finding:
+    return Finding(rule=rule, path=target.sources[0], line=1, col=0,
+                   message=f"[{target.name}] {message}")
+
+
+def check_collective_parity(target, built, builds) -> list:
+    """Both branches of every lowered ``lax.cond`` carry the same ordered
+    multiset of collectives (prim/axes/shape/dtype), OR the predicate is
+    provably axis-uniform (its backward slice passes through a reduction
+    covering the branches' collective axes — the psum-fallback discipline
+    from parallel/routing.py). Anything else can deadlock a mesh: members
+    disagreeing on the predicate enter mismatched collective schedules."""
+    out = []
+    for cond_eqn, encl, path in ir.conds_of(built.jaxpr):
+        per_branch = ir.branch_collectives(cond_eqn)
+        sigs = [Counter(c.signature() for c in br) for br in per_branch]
+        if all(s == sigs[0] for s in sigs):
+            continue
+        axes = set(a for br in per_branch for c in br for a in c.axes)
+        if ir.predicate_axis_reduced(cond_eqn, encl, axes):
+            continue
+        loc = "/".join(path) or "top"
+        detail = "; ".join(
+            f"branch[{i}]: " + (", ".join(str(c) for c in br) or "none")
+            for i, br in enumerate(per_branch)
+        )
+        out.append(_finding(
+            "collective-parity", target,
+            f"cond at {loc} has branch-divergent collectives and its "
+            f"predicate is not reduced over {sorted(axes)} — {detail}",
+        ))
+    return out
+
+
+def check_metrics_strip(target, built, builds) -> list:
+    """The ``collect_metrics=False`` program must equal its metrics-on
+    pair minus EXACTLY the declared metric reductions: identical
+    all_to_all/all_gather schedules (telemetry must never reshape data
+    movement), reductions(off) a sub-multiset of reductions(on), and the
+    difference count == ``meta["expected_metric_reductions"]`` (update the
+    declaration alongside obs/registry.py when a metric collective
+    lands)."""
+    pair = built.meta.get("metrics_pair")
+    if pair is None:
+        return []
+    on = builds(pair)
+    out = []
+    off_cols = ir.collectives_of(built.jaxpr)
+    on_cols = ir.collectives_of(on.jaxpr)
+
+    def _split(cols):
+        red = Counter(c.signature() for c in cols
+                      if c.prim in ir.REDUCTIONS)
+        moves = Counter(c.signature() for c in cols
+                        if c.prim not in ir.REDUCTIONS)
+        return red, moves
+
+    off_red, off_moves = _split(off_cols)
+    on_red, on_moves = _split(on_cols)
+    if off_moves != on_moves:
+        out.append(_finding(
+            "metrics-strip", target,
+            f"data-movement collectives differ from pair '{pair}': "
+            f"off-only={dict(off_moves - on_moves)} "
+            f"on-only={dict(on_moves - off_moves)}",
+        ))
+    extra_off = off_red - on_red
+    if extra_off:
+        out.append(_finding(
+            "metrics-strip", target,
+            f"reductions present with collect_metrics=False but absent in "
+            f"'{pair}': {dict(extra_off)} — a metric psum survived the "
+            "strip",
+        ))
+    expected = int(built.meta.get("expected_metric_reductions", 0))
+    stripped = sum((on_red - off_red).values())
+    if stripped != expected:
+        out.append(_finding(
+            "metrics-strip", target,
+            f"metrics-on program carries {stripped} extra reduction(s) "
+            f"over the stripped baseline, registry declares {expected}: "
+            f"{dict(on_red - off_red)}",
+        ))
+    return out
+
+
+def check_donation_audit(target, built, builds) -> list:
+    """Programs donate exactly the buffers they claim. A target claiming
+    donation (``meta['donated_leaves']``) must lower that many arguments
+    with a donation attr (``tf.aliasing_output`` or ``jax.buffer_donor``)
+    and emit zero unusable-donation warnings; a target claiming none must
+    lower zero. Any captured donation warning is a finding — an unusable
+    donation never lowers to an attr, saves nothing, and (donation
+    consumes its argument on every backend) deletes a buffer the caller
+    may still believe in."""
+    out = []
+    for w in built.donation_warnings:
+        out.append(_finding(
+            "donation-audit", target,
+            f"build emitted a donation warning: {w.splitlines()[0]}",
+        ))
+    attrs = ir.main_arg_attrs(built.mlir)
+    donated = sum(1 for a in attrs if a["aliased"] or a["donor"])
+    claimed = int(built.meta.get("donated_leaves", 0))
+    if donated != claimed:
+        out.append(_finding(
+            "donation-audit", target,
+            f"{donated} argument(s) lower with donation attrs, registry "
+            f"claims {claimed} (of {len(attrs)} args)",
+        ))
+    return out
+
+
+def check_dtype_discipline(target, built, builds) -> list:
+    """No f64/complex128 anywhere in a lowered program (the repo runs
+    x64-disabled; a wide float means a config leak or a silent upcast),
+    and on ``int8_path`` targets the routed all_to_all payload must carry
+    int8 codes — dequantizing before the wire silently 4x-es hop bytes
+    (feature/feature.py dequantizes AFTER the tier gathers by design)."""
+    out = []
+    for eqn, aval, path in ir.f64_eqns(built.jaxpr):
+        loc = "/".join(path) or "top"
+        out.append(_finding(
+            "dtype-discipline", target,
+            f"{eqn.primitive.name} at {loc} produces {aval.dtype}",
+        ))
+    if built.meta.get("int8_path"):
+        a2a = [c for c in ir.collectives_of(built.jaxpr)
+               if c.prim == "all_to_all"]
+        if not any(c.dtype == "int8" for c in a2a):
+            out.append(_finding(
+                "dtype-discipline", target,
+                "int8 tier path lowers no int8 all_to_all — codes were "
+                f"upcast before routing (saw {sorted({c.dtype for c in a2a})})",
+            ))
+    return out
+
+
+def check_constant_bloat(target, built, builds) -> list:
+    """Arrays closure-folded into a program as constants above the size
+    limit. Baked-in constants re-enter HBM per program version, defeat
+    the AOT ladder's executable cache keying, and mark an operand that
+    should have been an argument."""
+    limit = int(built.meta.get("const_bytes_limit", CONST_BYTES_LIMIT))
+    out = []
+    for const, path in ir.iter_consts(built.jaxpr):
+        nbytes = int(getattr(const, "nbytes", 0))
+        if nbytes > limit:
+            loc = "/".join(path) or "top"
+            shape = getattr(const, "shape", ())
+            dtype = getattr(const, "dtype", "?")
+            out.append(_finding(
+                "constant-bloat", target,
+                f"closure-folded constant {dtype}{list(shape)} "
+                f"({nbytes} bytes > {limit}) at {loc}",
+            ))
+    return out
+
+
+def check_comm_budget(target, built, builds) -> list:
+    """The lowered epoch body's routed all_to_all lanes reconcile with
+    ``control/cost.routed_lanes_per_hop`` EXACTLY: the ids hop is
+    ``int(F, cap)``, the payload hop ``(F, cap, feature_dim)``, and
+    ``F * cap == lanes_per_hop`` for the registry-declared
+    ``(local_len, F, alpha)``. Turns the scoreboard's analytic comm model
+    from a claim into a checked contract on the IR."""
+    comm = built.meta.get("comm")
+    if comm is None:
+        return []
+    from ...control.cost import routed_lanes_per_hop
+
+    F = int(comm["feature_shards"])
+    model = routed_lanes_per_hop(int(comm["local_len"]), F,
+                                 float(comm["alpha"]))
+    cap, lanes = int(model["cap"]), int(model["lanes_per_hop"])
+    a2a = [c for c in ir.collectives_of(built.jaxpr)
+           if c.prim == "all_to_all"]
+    out = []
+    if not a2a:
+        out.append(_finding(
+            "comm-budget", target,
+            "no all_to_all lowered in an epoch body declaring a comm "
+            "budget — the routed gather fell off the a2a path",
+        ))
+    for c in a2a:
+        ok_ids = (len(c.shape) == 2 and c.dtype.startswith("int")
+                  and tuple(c.shape) == (F, cap))
+        ok_payload = (len(c.shape) == 3
+                      and tuple(c.shape) == (F, cap,
+                                             int(comm["feature_dim"])))
+        if not (ok_ids or ok_payload):
+            out.append(_finding(
+                "comm-budget", target,
+                f"{c} does not match the comm model (expect ids "
+                f"int[{F}, {cap}] or payload [{F}, {cap}, "
+                f"{comm['feature_dim']}] for alpha={comm['alpha']}, "
+                f"local_len={comm['local_len']})",
+            ))
+        elif c.lanes != lanes:
+            out.append(_finding(
+                "comm-budget", target,
+                f"{c} moves {c.lanes} lanes/hop, model says {lanes}",
+            ))
+    return out
+
+
+RULES = {
+    "collective-parity": check_collective_parity,
+    "metrics-strip": check_metrics_strip,
+    "donation-audit": check_donation_audit,
+    "dtype-discipline": check_dtype_discipline,
+    "constant-bloat": check_constant_bloat,
+    "comm-budget": check_comm_budget,
+}
+
+FAMILIES = {
+    "parity": ("collective-parity",),
+    "metrics": ("metrics-strip",),
+    "donation": ("donation-audit",),
+    "dtype": ("dtype-discipline",),
+    "constants": ("constant-bloat",),
+    "comm": ("comm-budget",),
+}
+
+META_RULES = ("audit-error",)
+
+
+def family_of(rule: str) -> str:
+    for fam, rules in FAMILIES.items():
+        if rule in rules:
+            return fam
+    return "meta"
+
+
+def rule_docs() -> dict:
+    docs = {name: (fn.__doc__ or "").strip() for name, fn in RULES.items()}
+    docs["audit-error"] = ("a registered target failed to trace/lower — "
+                           "the program the invariant lives on no longer "
+                           "builds")
+    return docs

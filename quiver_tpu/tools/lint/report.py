@@ -2,11 +2,10 @@
 ``--debt`` suppression report.
 
 SARIF (Static Analysis Results Interchange Format, 2.1.0) is the shape CI
-platforms ingest for inline PR annotation; the builder here emits the
-minimal valid subset — one run, the rule registry as ``tool.driver.rules``
-(rule docs as help text), every active finding as an ``error`` result and
-every suppressed finding as a result carrying a ``suppressions`` entry
-whose justification is the inline reason.
+platforms ingest for inline PR annotation. The document builder lives in
+``tools/sarif.py`` — shared with graftaudit so both analyzers emit one
+schema and CI merges them into a single ``analysis.sarif`` artifact;
+this module binds it to graftlint's rule registry.
 
 The debt report makes reasoned-suppression count visible per PR: every
 ``# graftlint: disable=... -- why`` and ``# graftlint: eager -- why`` in
@@ -18,63 +17,17 @@ from __future__ import annotations
 
 import time
 
+from ..sarif import build_sarif_doc
 from .rules import family_of, rule_docs
 from .runner import LintResult
 
 __all__ = ["build_sarif", "build_debt", "format_debt"]
 
-_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
-                 "master/Schemata/sarif-schema-2.1.0.json")
-
-
-def _sarif_result(f, suppressed: bool) -> dict:
-    res = {
-        "ruleId": f.rule,
-        "level": "error",
-        "message": {"text": f.message},
-        "locations": [{
-            "physicalLocation": {
-                "artifactLocation": {"uri": f.path.replace("\\", "/")},
-                "region": {"startLine": int(f.line),
-                           "startColumn": int(f.col) + 1},
-            },
-        }],
-    }
-    if suppressed:
-        res["suppressions"] = [{"kind": "inSource",
-                                "justification": "reasoned inline "
-                                                 "suppression"}]
-    return res
-
 
 def build_sarif(result: LintResult) -> dict:
     """SARIF 2.1.0 document for a lint run (active + suppressed)."""
-    docs = rule_docs()
-    rules = [
-        {
-            "id": name,
-            "shortDescription": {
-                "text": (doc.splitlines()[0] if doc else name)},
-            "fullDescription": {"text": doc},
-            "properties": {"family": family_of(name)},
-        }
-        for name, doc in docs.items()
-    ]
-    results = [_sarif_result(f, False) for f in result.findings]
-    results += [_sarif_result(f, True) for f in result.suppressed]
-    return {
-        "$schema": _SARIF_SCHEMA,
-        "version": "2.1.0",
-        "runs": [{
-            "tool": {"driver": {
-                "name": "graftlint",
-                "informationUri":
-                    "https://github.com/quiver-tpu/quiver-tpu",
-                "rules": rules,
-            }},
-            "results": results,
-        }],
-    }
+    return build_sarif_doc("graftlint", rule_docs(), family_of,
+                           result.findings, result.suppressed)
 
 
 def _blame_age_days(path: str, line: int) -> float | None:

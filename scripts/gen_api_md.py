@@ -71,6 +71,10 @@ SECTIONS = [
     ("quiver_tpu.datasets", "Dataset loaders + planted graphs"),
     ("quiver_tpu.tools.lint",
      "graftlint static analyzer (trace-safety rules)"),
+    ("quiver_tpu.tools.audit",
+     "graftaudit — jaxpr/HLO program auditor (lowered-IR invariants)"),
+    ("quiver_tpu.tools.sarif",
+     "Shared SARIF plumbing (lint + audit, merged CI artifact)"),
 ]
 
 

@@ -54,6 +54,11 @@ ORDER = [
     # regression fails the session before any chip-window time is burned
     # on benchmarks whose numbers a broken invariant would poison
     ("lint", 120),
+    # graftaudit right after: the lowered-IR gate (collective parity,
+    # metric stripping, donation claims, dtype discipline, comm budget)
+    # is trace-only, so it proves the compiled-program invariants in
+    # seconds before any chip time executes a step on top of them
+    ("audit", 300),
     # chaos drills right after lint: resilience regressions (guard,
     # retry, checkpoint/resume bit-parity, elastic resize, corrupt-
     # checkpoint fallback, cold-tier outage) fail the session early,
@@ -104,6 +109,10 @@ EXTRA_JOBS = {
     "lint": ("quiver_tpu.tools.lint",
              [os.path.join(REPO, d)
               for d in ("quiver_tpu", "scripts", "benchmarks")]),
+    # graftaudit over the full program registry — traces/lowers on the
+    # session's backend, executes nothing; log-only, exits nonzero on a
+    # lowered-IR invariant regression
+    "audit": ("quiver_tpu.tools.audit", []),
     # FaultPlan smoke over a tiny epoch (guard skip, prefetch retry,
     # preempt/resume bit-parity) — log-only, asserts its own invariants
     "chaos": ("benchmarks.chaos", []),
@@ -129,11 +138,11 @@ def job_table():
     return [(k, by_key[k][0], list(by_key[k][1]), b) for k, b in ORDER]
 
 # jobs whose records feed the scoreboard table (acceptance/sweep/lint/
-# chaos log-only)
-TABLE_EXCLUDE = {"acceptance", "sweep", "lint", "chaos"}
+# audit/chaos log-only)
+TABLE_EXCLUDE = {"acceptance", "sweep", "lint", "audit", "chaos"}
 
 # jobs that emit no {"metric": ...} records; success = clean exit alone
-LOG_ONLY_JOBS = {"acceptance", "lint", "chaos"}
+LOG_ONLY_JOBS = {"acceptance", "lint", "audit", "chaos"}
 
 
 class JobTimeout(Exception):
@@ -244,6 +253,10 @@ def main():
             argv = list(argv) + [
                 "--sarif", os.path.join(args.out, "lint.sarif"), "--debt",
             ]
+        if key == "audit":
+            argv = list(argv) + [
+                "--sarif", os.path.join(args.out, "audit.sarif"),
+            ]
         todo.append((key, module, argv, budget))
     if not todo:
         mark("ALL DONE (nothing left to run)")
@@ -347,6 +360,27 @@ def main():
             mark(f"LINT GATE FAILED ({str(err)[:120]}); aborting session "
                  "before burning bench budget")
             return 5
+        if key == "audit":
+            # one merged analyzer artifact next to the scoreboard outputs
+            # (same shape CI uploads); merge_sarif_files skips missing
+            # inputs, so a lint-only or audit-only pass still writes it
+            try:
+                from quiver_tpu.tools.sarif import merge_sarif_files
+
+                merge_sarif_files(
+                    [os.path.join(args.out, "lint.sarif"),
+                     os.path.join(args.out, "audit.sarif")],
+                    os.path.join(args.out, "analysis.sarif"),
+                )
+            except Exception as e:  # noqa: BLE001
+                mark(f"sarif merge failed: {e}")
+            if err:
+                # fail FAST, same reasoning as the lint gate: a lowered-IR
+                # invariant regression (collective parity, donation claim,
+                # comm budget...) poisons every number measured on top of it
+                mark(f"AUDIT GATE FAILED ({str(err)[:120]}); aborting "
+                     "session before burning bench budget")
+                return 6
         if key not in TABLE_EXCLUDE:
             job_result = {"key": key, "note": notes.get(key, ""),
                           "records": recs, "error": err,

@@ -1,0 +1,9 @@
+"""Seeded positive/negative programs for the graftaudit rule families.
+
+Each module exposes ``targets()`` returning
+``[(Target, should_fire: bool)]`` — real traced programs (not mocked
+IR) so the fixtures break loudly if jax's lowering of the audited
+construct ever changes shape. ``tests/test_audit.py`` builds each with
+``audit_targets.build_from`` and asserts every positive is caught and
+every negative stays clean.
+"""

@@ -1,0 +1,41 @@
+"""constant-bloat fixtures: a closure-folded table (positive) vs the same
+table passed as an operand (negative)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quiver_tpu.tools.audit.audit_targets import Target
+
+_TABLE = np.arange(8192, dtype=np.float32).reshape(1024, 8)  # 32 KiB
+_LIMIT = 16 * 1024
+
+
+def _folded():
+    table = jnp.asarray(_TABLE)
+
+    def run(ids):
+        return table[ids]  # table rides the closure -> a program constant
+
+    return jax.jit(run).trace(jax.ShapeDtypeStruct((4,), jnp.int32))
+
+
+def _operand():
+    def run(table, ids):
+        return table[ids]
+
+    return jax.jit(run).trace(
+        jax.ShapeDtypeStruct(_TABLE.shape, jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.int32),
+    )
+
+
+def targets():
+    src = ("tests/audit_fixtures/constant_fixtures.py",)
+    meta = {"const_bytes_limit": _LIMIT}  # keep the fixture table small
+    return [
+        (Target("const_folded", "closure-captured feature table",
+                _folded, src, meta=meta), True),
+        (Target("const_operand", "table passed as an argument",
+                _operand, src, meta=meta), False),
+    ]

@@ -1,0 +1,45 @@
+"""donation-audit fixtures: an unusable donation and an unclaimed-donation
+mismatch (positives); an honest donated accumulator (negative)."""
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu.tools.audit.audit_targets import Target
+
+
+def _unusable():
+    # (8,) can never alias the (2,) output: jax warns at lower time and
+    # the donation lowers to no attr at all — the serve-forward bug shape
+    def run(x, y):
+        return jnp.sum(x.reshape(2, 4), axis=1) + y
+
+    return jax.jit(run, donate_argnums=0).trace(
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((2,), jnp.float32),
+    )
+
+
+def _honest():
+    # same-shape accumulate: the donated arg aliases the output
+    def run(acc, upd):
+        return acc + upd
+
+    return jax.jit(run, donate_argnums=0).trace(
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+
+
+def targets():
+    src = ("tests/audit_fixtures/donation_fixtures.py",)
+    return [
+        (Target("donation_unusable", "warning-only donation", _unusable,
+                src, meta={"donation": "none"}), True),
+        # claims one donated leaf but donates nothing
+        (Target("donation_unclaimed", "claimed leaf never donated",
+                lambda: jax.jit(lambda x: x * 2.0).trace(
+                    jax.ShapeDtypeStruct((8,), jnp.float32)),
+                src, meta={"donated_leaves": 1}), True),
+        (Target("donation_honest", "aliased accumulator donation",
+                _honest, src, meta={"donated_leaves": 1}), False),
+    ]

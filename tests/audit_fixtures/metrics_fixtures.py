@@ -1,0 +1,56 @@
+"""metrics-strip fixtures: a psum surviving ``collect_metrics=False``
+(positive) and a correctly stripped pair (negative)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, make_mesh, \
+    shard_map
+from quiver_tpu.tools.audit.audit_targets import Target
+
+
+def _traced(body):
+    mesh = make_mesh(2, data=1, feature=2)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(FEATURE_AXIS),), out_specs=P(FEATURE_AXIS),
+        check_vma=False,
+    ))
+    return fn.trace(jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
+def _step(metric_psums):
+    def body(x):
+        # x is the (4,) LOCAL block; the "training math" is one
+        # data-movement collective that must be identical on/off
+        y = jax.lax.all_to_all(x.reshape(2, 2), FEATURE_AXIS, 0, 0)
+        out = y.reshape(4) * 2.0
+        for _ in range(metric_psums):
+            # a telemetry reduction riding alongside the math
+            out = out + 0.0 * jax.lax.psum(jnp.sum(x), DATA_AXIS)
+        return out
+
+    return body
+
+
+def targets():
+    src = ("tests/audit_fixtures/metrics_fixtures.py",)
+    on = Target("metrics_fix_on", "metrics-on half of the pair",
+                lambda: _traced(_step(1)), src)
+    # positive: the "off" program kept a psum the on program doesn't even
+    # have (a metric collective survived the strip — and worse, drifted)
+    off_leaky = Target(
+        "metrics_fix_off_leaky", "psum survives collect_metrics=False",
+        lambda: _traced(_step(2)), src,
+        meta={"metrics_pair": "metrics_fix_on",
+              "expected_metric_reductions": 1},
+    )
+    # negative: off == on minus exactly the declared telemetry reduction
+    off_clean = Target(
+        "metrics_fix_off_clean", "correctly stripped program",
+        lambda: _traced(_step(0)), src,
+        meta={"metrics_pair": "metrics_fix_on",
+              "expected_metric_reductions": 1},
+    )
+    return [(on, False), (off_leaky, True), (off_clean, False)]

@@ -1,0 +1,58 @@
+"""collective-parity fixtures: a de-synced cond fallback (positive) and
+the repo's psum-gated discipline (negative)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.parallel.mesh import FEATURE_AXIS, make_mesh, shard_map
+from quiver_tpu.tools.audit.audit_targets import Target
+
+
+def _traced(body):
+    mesh = make_mesh(2, data=1, feature=2)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(FEATURE_AXIS),), out_specs=P(FEATURE_AXIS),
+        check_vma=False,
+    ))
+    return fn.trace(jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
+def _pos():
+    def body(x):
+        # the bug graftaudit exists for: the predicate is a LOCAL value
+        # (never reduced over 'feature'), so mesh members can disagree —
+        # one enters the psum, its peer does not, and the mesh deadlocks
+        pred = x[0] > 0.0
+        return jax.lax.cond(
+            pred,
+            lambda v: jax.lax.psum(v, FEATURE_AXIS),
+            lambda v: v * 2.0,
+            x,
+        )
+
+    return _traced(body)
+
+
+def _neg():
+    def body(x):
+        # routing.py's fallback discipline: psum the predicate first, so
+        # every member of the axis takes the same branch
+        pred = jax.lax.psum(jnp.sum(x), FEATURE_AXIS) > 0.0
+        return jax.lax.cond(
+            pred,
+            lambda v: jax.lax.psum(v, FEATURE_AXIS),
+            lambda v: v * 2.0,
+            x,
+        )
+
+    return _traced(body)
+
+
+def targets():
+    src = ("tests/audit_fixtures/parity_fixtures.py",)
+    return [
+        (Target("parity_pos", "de-synced cond fallback", _pos, src), True),
+        (Target("parity_neg", "psum-gated cond fallback", _neg, src), False),
+    ]

@@ -319,6 +319,11 @@ def _tiny_trainer(collect_metrics: bool):
     return trainer, params, opt, labels
 
 
+@pytest.mark.slow  # IR-proven fast: graftaudit's metrics-strip rule
+# diffs the lowered on/off step programs every tier-1 run — identical
+# data-movement collectives, exactly the declared metric psums stripped
+# (tests/test_audit.py); this execution differential is the slow-lane
+# end-to-end witness
 def test_metrics_on_off_loss_bitwise_identical():
     """Acceptance: metrics collection disabled vs enabled yields a
     bit-identical loss trajectory over an epoch_scan epoch (the metric

@@ -92,3 +92,54 @@ def test_windowed_sampler_small_graph_rejected():
         sample_layer_windowed(
             topo, jnp.zeros(8, jnp.int32), jnp.int32(1), 2, jax.random.PRNGKey(0)
         )
+
+
+# -- jitted-lowering smoke (the QUIVER_GATHER_KERNEL election contract) -------
+#
+# The election (feature._hot_gather_fn / resolve_gather_kernel) can route
+# EVERY hot-tier gather through the Pallas kernels inside jitted trainer
+# and serving programs — where the kernels run under jax.jit tracing, not
+# eagerly. These smokes pin that lowering path: sample_layer_windowed once
+# indexed a host-numpy indptr with a tracer and broke ONLY under jit,
+# which no eager test could see. graftaudit's pallas_* targets keep the
+# trace/lower half checked statically; these keep interpret-mode execution
+# bitwise-equal to eager.
+
+
+def test_gather_rows_jitted_matches_eager():
+    t = np.random.default_rng(5).normal(size=(120, 16)).astype(np.float32)
+    ids = np.random.default_rng(6).integers(0, 120, 33).astype(np.int32)
+    fn = lambda tbl, i: gather_rows(tbl, i, interpret=True)  # noqa: E731
+    eager = np.asarray(fn(jnp.asarray(t), jnp.asarray(ids)))
+    jitted = np.asarray(jax.jit(fn)(jnp.asarray(t), jnp.asarray(ids)))
+    assert np.array_equal(eager, jitted)
+    assert np.array_equal(eager, t[ids])
+
+
+def test_hot_gather_election_int8_jitted():
+    # the int8 tier stores codes; the elected pallas gather must move them
+    # un-upcast under jit exactly as the xla take does
+    from quiver_tpu.feature.feature import _hot_gather_fn
+
+    codes = np.random.default_rng(7).integers(
+        -128, 128, size=(90, 8)).astype(np.int8)
+    ids = np.random.default_rng(8).integers(0, 90, 40).astype(np.int32)
+    tbl = jnp.asarray(codes)
+    for kernel in ("pallas", "xla"):
+        out = jax.jit(_hot_gather_fn(tbl, kernel))(jnp.asarray(ids))
+        assert out.dtype == jnp.int8, kernel
+        assert np.array_equal(np.asarray(out), codes[ids]), kernel
+
+
+def test_windowed_sampler_jitted_matches_eager():
+    ei = generate_pareto_graph(400, 6.0, seed=9)
+    topo = CSRTopo(edge_index=ei)  # host-numpy arrays: the regression shape
+    seeds = jnp.asarray(np.random.default_rng(10).integers(0, 400, 24),
+                        jnp.int32)
+    key = jax.random.PRNGKey(11)
+    fn = lambda s, k: sample_layer_windowed(  # noqa: E731
+        topo, s, jnp.int32(24), 5, k, window=256)
+    nbr_e, cnt_e = fn(seeds, key)
+    nbr_j, cnt_j = jax.jit(fn)(seeds, key)
+    assert np.array_equal(np.asarray(nbr_e), np.asarray(nbr_j))
+    assert np.array_equal(np.asarray(cnt_e), np.asarray(cnt_j))

@@ -160,6 +160,10 @@ def test_sharded_feature_int8_capped_routed_dequant():
     assert np.array_equal(a, b)
 
 
+@pytest.mark.slow  # IR-proven fast: graftaudit collective-parity +
+# comm-budget walk the capped gather's lowered fallback cond and lane
+# shapes every tier-1 run (tests/test_audit.py); this execution
+# differential stays as the slow-lane end-to-end witness
 def test_trainer_capped_loss_bit_identical_and_overflow_observable():
     """DistributedTrainer(seed_sharding="all"): the capped-bucket gather
     must not change the training math at all — losses bit-identical to the
