@@ -37,9 +37,12 @@ def main():
     p.add_argument(
         "--kernel",
         default="xla",
-        choices=["xla", "pallas"],
-        help="sampling kernel: exact XLA stratified sampler or the Pallas "
-        "windowed-DMA kernel (HBM mode, unweighted)",
+        choices=["xla", "pallas", "fused", "auto"],
+        help="sampling kernel: exact XLA stratified sampler, the fused "
+        "Pallas megakernel ('pallas' and 'fused' are the same engine — "
+        "one windowed-DMA kernel behind every variant, weighted and "
+        "sharded included; 'fused' names the scoreboard lane), or 'auto' "
+        "(measured election, QUIVER_SAMPLE_KERNEL overrides)",
     )
     p.add_argument(
         "--dedup",
@@ -156,25 +159,35 @@ def _stage_profile(args, sampler, topo, reps: int = 30):
 
     use_pallas = sampler.kernel == "pallas"
     if use_pallas:
-        from quiver_tpu.ops.pallas.sample import (
+        from quiver_tpu.ops.pallas.fused import (
             DEFAULT_WINDOW,
-            sample_layer_windowed,
+            fused_sample_layer,
         )
 
-        # same trace-time fallback rule the fused program applies
-        use_pallas = sampler.topo.indices.shape[0] >= DEFAULT_WINDOW
+        # same trace-time fallback rules the fused program applies
+        E = int(sampler.topo.indices.shape[0])
+        md = getattr(sampler.topo, "max_degree", None)
+        use_pallas = (
+            E >= DEFAULT_WINDOW
+            and max(sampler.sizes) <= DEFAULT_WINDOW
+            and not (sampler.weighted
+                     and (md is None or md > DEFAULT_WINDOW))
+        )
 
+    weighted = sampler.weighted
     for l, k in enumerate(sampler.sizes):
         key, sub = jax.random.split(key)
         if use_pallas:
             f_sample = jax.jit(
-                lambda t, c, n, kk, fan=k: sample_layer_windowed(
-                    t, c, n, fan, kk
+                lambda t, c, n, kk, fan=k: fused_sample_layer(
+                    t, c, n, fan, kk, weighted=weighted
                 )
             )
         else:
             f_sample = jax.jit(
-                lambda t, c, n, kk, fan=k: sample_layer(t, c, n, fan, kk)
+                lambda t, c, n, kk, fan=k: sample_layer(
+                    t, c, n, fan, kk, weighted=weighted
+                )
             )
         (nbr, counts), t_sample = timed(f_sample, sampler.topo, cur, cur_n, sub)
         # honor the sampler's dedup strategy (same node_bound rule as
@@ -241,7 +254,7 @@ def _stream_seps(args, sampler, topo, reps: int = 3):
         for dedup in ("map", "scan"):
             other = GraphSageSampler(
                 topo, args.fanout, mode=args.mode, seed_capacity=cap,
-                seed=args.seed, kernel=args.kernel, dedup=dedup,
+                seed=args.seed, kernel=sampler.kernel, dedup=dedup,
                 weighted=sampler.weighted,
                 frontier_caps=(
                     tuple(sampler._frontier_caps)
@@ -362,8 +375,6 @@ def _body_sharded(args):
     from quiver_tpu import GraphSageSampler
     from quiver_tpu.parallel.mesh import make_mesh
 
-    if args.kernel != "xla":
-        raise SystemExit("--topo-sharding mesh supports --kernel xla only")
     if args.mode not in ("HBM", "GPU"):
         raise SystemExit("--topo-sharding mesh requires --mode HBM (each "
                          "shard's slice is device-resident — that is the "
@@ -389,6 +400,7 @@ def _body_sharded(args):
     alpha = args.routed_alpha or None
     sampler = GraphSageSampler(
         topo, args.fanout, mode="HBM", seed=args.seed, dedup=dedup,
+        kernel="pallas" if args.kernel == "fused" else args.kernel,
         topo_sharding="mesh", mesh=mesh, routed_alpha=alpha,
         weighted=args.weighted,
         frontier_caps="auto" if args.caps == "auto" else None,
@@ -461,8 +473,8 @@ def _body(args):
 
     topo = build_graph(args)
     if args.weighted:
-        if args.kernel == "pallas":
-            raise SystemExit("--weighted supports the xla kernel only")
+        # the fused megakernel serves weighted draws too (ISSUE 16): no
+        # kernel restriction — the inverse-CDF walk runs in-kernel
         w = np.exp(
             np.random.default_rng(args.seed + 5).normal(size=topo.edge_count)
         ).astype(np.float32)
@@ -470,7 +482,8 @@ def _body(args):
     base_dedup = "sort" if args.dedup == "both" else args.dedup
     sampler = GraphSageSampler(
         topo, args.fanout, mode=args.mode, seed_capacity=args.batch,
-        seed=args.seed, kernel=args.kernel, dedup=base_dedup,
+        seed=args.seed, dedup=base_dedup,
+        kernel="pallas" if args.kernel == "fused" else args.kernel,
         weighted=args.weighted,
         frontier_caps="auto" if args.caps == "auto" else None,
     )
@@ -482,6 +495,7 @@ def _body(args):
         jax.block_until_ready(out.n_id)
     log(f"warmup+compile: {time.time()-t0:.1f}s")
 
+    n_compiled = len(sampler._compiled_cache)
     total_edges = 0
     t0 = time.time()
     for _ in range(args.iters):
@@ -491,6 +505,12 @@ def _body(args):
     jax.block_until_ready(out.n_id)
     dt = time.time() - t0
     percall_seps = total_edges / dt
+    # steady state must never recompile: the warmup loop owns every
+    # (seed_cap, caps) program this batch shape can demand
+    recompiles_steady = len(sampler._compiled_cache) - n_compiled
+    if recompiles_steady:
+        log(f"WARNING: {recompiles_steady} steady-state recompile(s) — "
+            "the sampler program must be compiled once per shape")
 
     stage_sampler = sampler
     if args.dedup == "both" and not args.stream:
@@ -520,6 +540,7 @@ def _body(args):
         dedup=base_dedup,
         weighted=args.weighted,
         dispatch="percall",
+        recompiles_steady=recompiles_steady,
     )
 
     if getattr(args, "stages", False):

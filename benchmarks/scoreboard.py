@@ -59,6 +59,13 @@ JOBS = [
     ("sampler-pallas", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--kernel", "pallas", "--stream", "128"],
      "windowed Pallas kernel vs the XLA row above"),
+    ("sampler-fused-pallas", "benchmarks.bench_sampler",
+     ["--mode", "HBM", "--kernel", "fused", "--weighted", "--stream",
+      "128", "--stages"],
+     "fused sample megakernel on the weighted inverse-CDF path — the "
+     "variant the capability matrix used to refuse (ISSUE 16); the stage "
+     "table attributes the sample-stage share vs the XLA sampler-weighted "
+     "row and recompiles_steady must stay 0"),
     ("sampler-weighted", "benchmarks.bench_sampler",
      ["--mode", "HBM", "--weighted", "--stream", "128", "--dedup", "both"],
      "weight-proportional draws — the path the reference never shipped "

@@ -545,7 +545,8 @@ def place_csr_arrays(indptr, indices, eid, cum_weights, max_degree: int,
     )
     return DeviceTopology(indptr=indptr, indices=indices, eid=eid,
                           cum_weights=cum_weights, edge_time=edge_time,
-                          host_indices=host, search_iters=iters)
+                          host_indices=host, search_iters=iters,
+                          max_degree=int(max_degree))
 
 
 @jax.tree_util.register_pytree_node_class
@@ -554,11 +555,14 @@ class DeviceTopology:
 
     ``host_indices`` is static metadata: True when ``indices``/``eid`` live in
     pinned host memory (HOST mode) so gathers must stage through host compute.
+    ``max_degree`` is static host metadata (None when unknown, e.g. a
+    hand-built topology); the fused Pallas sampler uses it for trace-time
+    window-coverage decisions.
     """
 
     def __init__(self, indptr, indices, eid=None, cum_weights=None,
                  edge_time=None, host_indices: bool = False,
-                 search_iters: int = 0):
+                 search_iters: int = 0, max_degree: int | None = None):
         self.indptr = indptr
         self.indices = indices
         self.eid = eid
@@ -566,6 +570,7 @@ class DeviceTopology:
         self.edge_time = edge_time
         self.host_indices = host_indices
         self.search_iters = search_iters
+        self.max_degree = max_degree
 
     @property
     def node_count(self) -> int:
@@ -578,10 +583,12 @@ class DeviceTopology:
     def tree_flatten(self):
         children = (self.indptr, self.indices, self.eid, self.cum_weights,
                     self.edge_time)
-        return children, (self.host_indices, self.search_iters)
+        return children, (self.host_indices, self.search_iters,
+                          self.max_degree)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         indptr, indices, eid, cum_weights, edge_time = children
         return cls(indptr, indices, eid, cum_weights, edge_time,
-                   host_indices=aux[0], search_iters=aux[1])
+                   host_indices=aux[0], search_iters=aux[1],
+                   max_degree=aux[2])

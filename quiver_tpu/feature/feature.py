@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from ..core.config import CachePolicy, parse_size_bytes
 from ..core.memory import to_pinned_host
 from ..core.topology import CSRTopo
+from ..ops.election import KernelElection, validate_kernel_arg
 from ..ops.sample import staged_gather
 from ..utils.reorder import reorder_by_degree
 from ..utils.trace import get_logger, info_once, trace_scope
@@ -121,9 +122,7 @@ def wrap_dequant_gathers(scale, hot_rows: int, hot_gather, cold_gather,
 def validate_gather_kernel(kernel: str) -> str:
     """Eager argument check only — MUST NOT touch the JAX backend (object
     construction must stay cheap and never initialize/lock backend choice)."""
-    if kernel not in ("auto", "pallas", "xla"):
-        raise ValueError(f"kernel must be auto|pallas|xla, got {kernel!r}")
-    return kernel
+    return validate_kernel_arg(kernel)
 
 
 def resolve_gather_kernel(kernel: str) -> str:
@@ -132,29 +131,22 @@ def resolve_gather_kernel(kernel: str) -> str:
 
     ``"auto"`` on TPU ELECTS BY MEASURED THROUGHPUT between the Pallas
     row-DMA kernel (ops/pallas/gather.py — the ``quiver_tensor_gather``
-    analogue, shard_tensor.cu.hpp:16-58) and the stock XLA take: a
-    correctness smoke gates Pallas (a regression degrades auto to xla with
-    a warning), then a 2-candidate fused-scan micro-bench picks the faster
-    kernel — "it compiled and returned right rows" is not evidence it is
-    fast (VERDICT r3 item 4). The election is cached per process and on
-    disk (keyed by device kind), and ``QUIVER_GATHER_KERNEL=pallas|xla``
-    overrides it. Off-TPU auto is xla (the Pallas CPU path is correct but
-    slow). An explicit ``kernel="pallas"`` bypasses everything (fail loudly
-    on request). Env-before-first-use: both knobs (the force and
-    ``QUIVER_ELECTION_CACHE``) are resolved ONCE per process at the first
-    auto resolution — set them before the first gather; flipping them
-    afterwards is inert (tests/test_kernel_election.py pins this).
+    analogue, shard_tensor.cu.hpp:16-58) and the stock XLA take, via the
+    shared ``ops.election.KernelElection`` machinery: a correctness smoke
+    gates Pallas (a regression degrades auto to xla with a warning), then
+    a 2-candidate fused-scan micro-bench picks the faster kernel — "it
+    compiled and returned right rows" is not evidence it is fast (VERDICT
+    r3 item 4). The election is cached per process and on disk (the shared
+    ``QUIVER_ELECTION_CACHE`` file, keyed by device kind), and
+    ``QUIVER_GATHER_KERNEL=pallas|xla`` overrides it. Off-TPU auto is xla
+    (the Pallas CPU path is correct but slow). An explicit
+    ``kernel="pallas"`` bypasses everything (fail loudly on request).
+    Env-before-first-use: both knobs (the force and the cache path) are
+    resolved ONCE per process at the first auto resolution — set them
+    before the first gather; flipping them afterwards is inert
+    (tests/test_kernel_election.py pins this).
     """
-    validate_gather_kernel(kernel)
-    if kernel == "auto":
-        try:
-            backend = jax.default_backend()
-        except RuntimeError:
-            return "xla"
-        if backend != "tpu":
-            return "xla"
-        return _elect_gather_kernel()
-    return kernel
+    return GATHER_ELECTION.resolve_request(kernel)
 
 
 _PALLAS_GATHER_OK: bool | None = None
@@ -226,110 +218,18 @@ def _measure_gather_gbps(kernel: str, rows: int = 65536, dim: int = 128,
     return nbytes / sorted(times)[1] / 1e9
 
 
-_GATHER_ELECTION: dict | None = None
-
-# bump when either gather kernel's implementation changes: the disk cache
-# is keyed on this + the jax version + the device kind, so a kernel or
-# toolchain change forces re-election instead of trusting stale numbers
-_ELECTION_REV = 1
-
-
-def _election_cache_key() -> str:
-    return f"rev{_ELECTION_REV}-jax{jax.__version__}-" + str(
-        jax.devices()[0].device_kind
-    )
-
-
-_ELECTION_CACHE_PATH: str | None = None
-
-
-def _election_cache_path() -> str:
-    """Disk-cache path for the kernel election (``QUIVER_ELECTION_CACHE``),
-    resolved ONCE per process. Env-before-first-use: the election runs
-    behind the first ``kernel="auto"`` gather — which may sit inside a
-    traced body, where a per-call env read would freeze at first trace
-    while looking live (graftlint env-at-trace). Tests reset
-    ``_ELECTION_CACHE_PATH`` to re-resolve."""
-    global _ELECTION_CACHE_PATH
-    if _ELECTION_CACHE_PATH is None:
-        import os
-
-        _ELECTION_CACHE_PATH = os.environ.get(
-            "QUIVER_ELECTION_CACHE",
-            os.path.expanduser("~/.cache/quiver_tpu/gather_election.json"),
-        )
-    return _ELECTION_CACHE_PATH
-
-
-_FORCED_GATHER_KERNEL: str | None = None
-
-
-def _forced_gather_kernel() -> str:
-    """The ``QUIVER_GATHER_KERNEL`` force ("" = none), read ONCE per
-    process — the same env-before-first-use contract as
-    ``models/layers.resolve_counts_strategy``: set it before the first
-    ``kernel="auto"`` resolution (chip-window forcing precedes the first
-    gather). Tests reset ``_FORCED_GATHER_KERNEL`` to re-resolve."""
-    global _FORCED_GATHER_KERNEL
-    if _FORCED_GATHER_KERNEL is None:
-        import os
-
-        _FORCED_GATHER_KERNEL = os.environ.get(
-            "QUIVER_GATHER_KERNEL", "").strip().lower()
-    return _FORCED_GATHER_KERNEL
-
-
-def _elect_gather_kernel() -> str:
-    """TPU kernel=auto election: measured pallas-vs-xla GB/s, not compile
-    success. Cached per process and on disk so every supervised benchmark
-    subprocess doesn't re-pay the two micro-bench compiles."""
-    import json
-    import os
-
-    global _GATHER_ELECTION
-    if _GATHER_ELECTION is not None:
-        return _GATHER_ELECTION["kernel"]
-    log = get_logger("feature")
-    forced = _forced_gather_kernel()
-    if forced in ("pallas", "xla"):
-        _GATHER_ELECTION = {"kernel": forced, "how": "env override"}
-        return forced
-    if not _pallas_gather_usable():
-        _GATHER_ELECTION = {"kernel": "xla", "how": "pallas smoke failed"}
-        return "xla"
-    cache_key = _election_cache_key()
-    path = _election_cache_path()
-    try:
-        with open(path) as f:
-            cached = json.load(f)
-        if cached.get("key") == cache_key and cached.get(
-                "kernel") in ("pallas", "xla"):
-            _GATHER_ELECTION = {**cached, "how": "disk cache"}
-            log.info("gather kernel=auto -> %s (cached election: %s)",
-                     cached["kernel"], cached.get("gbps"))
-            return cached["kernel"]
-    except (OSError, ValueError):
-        pass
-    try:
-        gbps = {k: round(_measure_gather_gbps(k), 2)
-                for k in ("xla", "pallas")}
-        kernel = max(gbps, key=gbps.get)
-    except Exception as e:  # noqa: BLE001 — a bench failure must not take
-        # down every feature gather; fall back to the safe default
-        log.warning("gather kernel election failed (%s: %s); auto -> xla",
-                    type(e).__name__, str(e)[:200])
-        _GATHER_ELECTION = {"kernel": "xla", "how": "election failed"}
-        return "xla"
-    _GATHER_ELECTION = {"kernel": kernel, "gbps": gbps,
-                        "key": cache_key, "how": "measured"}
-    log.info("gather kernel=auto -> %s (measured GB/s: %s)", kernel, gbps)
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump({"kernel": kernel, "gbps": gbps, "key": cache_key}, f)
-    except OSError:
-        pass
-    return kernel
+# GB/s election between the Pallas row-DMA gather and the XLA take. The
+# rev is bumped when either gather kernel's implementation changes: the
+# disk cache is keyed on rev + jax version + device kind, so a kernel or
+# toolchain change forces re-election instead of trusting stale numbers.
+# The smoke/measure callables defer module-global lookup so tests can
+# monkeypatch feature._pallas_gather_usable / _measure_gather_gbps.
+GATHER_ELECTION = KernelElection(
+    "gather", env_var="QUIVER_GATHER_KERNEL", rev=1,
+    smoke=lambda: _pallas_gather_usable(),  # noqa: PLW0108 — late binding
+    measure=lambda kernel: _measure_gather_gbps(kernel),
+    unit="GB/s", log_child="feature",
+)
 
 
 def _hot_gather_fn(table, kernel: str):

@@ -1,0 +1,222 @@
+"""Measured kernel elections (pallas vs xla) with one shared disk cache.
+
+The gather election (feature/feature.py, the ``quiver_tensor_gather``
+precedent) and the sample election (sampling/sampler.py, the fused
+megakernel) follow one contract, factored here:
+
+1. an explicit ``kernel="pallas"|"xla"`` bypasses everything (fail loudly
+   on request);
+2. ``kernel="auto"`` off-TPU resolves to xla (the Pallas CPU interpret
+   path is correct but slow);
+3. on TPU, auto runs a one-time correctness smoke (a Pallas regression
+   degrades auto to xla with ONE warning — fail-safe, never fail-closed),
+   then ELECTS BY MEASURED THROUGHPUT between the two kernels — "it
+   compiled and returned right rows" is not evidence it is fast (VERDICT
+   r3 item 4);
+4. the election is memoised per process and persisted in ONE disk cache
+   file shared by every election (``QUIVER_ELECTION_CACHE``, default
+   ``~/.cache/quiver_tpu/kernel_elections.json``), keyed by election name
+   and invalidated by (rev, jax version, device kind) so a kernel or
+   toolchain change forces re-election instead of trusting stale numbers;
+5. ``env_var=pallas|xla`` (e.g. ``QUIVER_GATHER_KERNEL``,
+   ``QUIVER_SAMPLE_KERNEL``) overrides the measurement.
+
+Env-before-first-use: the force knob and ``QUIVER_ELECTION_CACHE`` are
+resolved ONCE per process at the first auto resolution — the election
+runs behind the first ``kernel="auto"`` call, which may sit inside a
+traced body, where a per-call env read would freeze at first trace while
+looking live (graftlint env-at-trace). Set them before the first
+gather/sample; flipping them afterwards is inert
+(tests/test_kernel_election.py pins this). Tests call ``reset()`` (and
+reset ``_ELECTION_CACHE_PATH``) to simulate a fresh process.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+
+from ..utils.trace import get_logger
+
+__all__ = ["KernelElection", "validate_kernel_arg"]
+
+
+def validate_kernel_arg(kernel: str) -> str:
+    """Eager argument check only — MUST NOT touch the JAX backend (object
+    construction must stay cheap and never initialize/lock backend choice)."""
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"kernel must be auto|pallas|xla, got {kernel!r}")
+    return kernel
+
+
+_ELECTION_CACHE_PATH: str | None = None
+
+
+def _election_cache_path() -> str:
+    """Disk-cache path shared by ALL elections (``QUIVER_ELECTION_CACHE``),
+    resolved ONCE per process (env-before-first-use, see module docstring).
+    Tests reset ``_ELECTION_CACHE_PATH`` to re-resolve."""
+    global _ELECTION_CACHE_PATH
+    if _ELECTION_CACHE_PATH is None:
+        import os
+
+        _ELECTION_CACHE_PATH = os.environ.get(
+            "QUIVER_ELECTION_CACHE",
+            os.path.expanduser("~/.cache/quiver_tpu/kernel_elections.json"),
+        )
+    return _ELECTION_CACHE_PATH
+
+
+class KernelElection:
+    """One named pallas-vs-xla election (see module docstring for the
+    contract).
+
+    ``smoke`` is a zero-arg correctness gate (False/raise degrades auto to
+    xla); ``measure`` maps ``"pallas"|"xla"`` to a higher-is-better score
+    in ``unit``. Both are called lazily at first auto resolution, never at
+    construction. ``result`` exposes the decided election
+    (``{"kernel", "how", ...}``) for tests and telemetry; ``reset()`` is
+    the test seam simulating a fresh process (forgets the memo AND the
+    pinned env force — not the shared cache-path pin, which
+    tests/monkeypatch reset on the module).
+    """
+
+    def __init__(self, name: str, env_var: str, rev: int,
+                 smoke: Callable[[], bool],
+                 measure: Callable[[str], float],
+                 unit: str = "GB/s", log_child: str | None = None):
+        self.name = name
+        self.env_var = env_var
+        self.rev = int(rev)
+        self._smoke = smoke
+        self._measure = measure
+        self.unit = unit
+        self._log_child = log_child or name
+        self.result: dict | None = None
+        self._forced: str | None = None
+
+    # -- env force (pinned at first use) ----------------------------------
+    def forced(self) -> str:
+        """The env force ("" = none), read ONCE per process."""
+        if self._forced is None:
+            import os
+
+            self._forced = os.environ.get(self.env_var, "").strip().lower()
+        return self._forced
+
+    # -- disk cache (one file, nested by election name) -------------------
+    def cache_key(self) -> str:
+        return (f"rev{self.rev}-jax{jax.__version__}-"
+                + str(jax.devices()[0].device_kind))
+
+    def _load_cached(self, cache_key: str) -> dict | None:
+        import json
+
+        try:
+            with open(_election_cache_path()) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return None
+        entry = blob.get(self.name) if isinstance(blob, dict) else None
+        if (isinstance(entry, dict) and entry.get("key") == cache_key
+                and entry.get("kernel") in ("pallas", "xla")):
+            return entry
+        return None
+
+    def _store(self, entry: dict) -> None:
+        import json
+        import os
+
+        path = _election_cache_path()
+        try:
+            try:
+                with open(path) as f:
+                    blob = json.load(f)
+            except (OSError, ValueError):
+                blob = {}
+            if not isinstance(blob, dict):
+                blob = {}
+            # drop anything that is not a nested election entry (e.g. a
+            # pre-generalization flat gather_election.json pointed at by
+            # QUIVER_ELECTION_CACHE)
+            blob = {k: v for k, v in blob.items()
+                    if isinstance(v, dict) and "kernel" in v}
+            blob[self.name] = entry
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(blob, f)
+        except OSError:
+            pass
+
+    # -- resolution --------------------------------------------------------
+    # The instance-attribute form of the module-global resolve-once idiom:
+    # the slow path (env pin, smoke, micro-bench, one log line each) runs
+    # at most once per process, at or before the first trace —
+    # env-before-first-use is documented in the module docstring and
+    # pinned by tests/test_kernel_election.py.
+    # graftlint: eager -- resolve-once barrier memoised on self.result; the smoke/micro-bench/log slow path runs at most once per process
+    def elect(self) -> str:
+        """TPU kernel=auto election: measured pallas-vs-xla, not compile
+        success. Cached per process and on disk so every supervised
+        benchmark subprocess doesn't re-pay the two micro-bench compiles."""
+        if self.result is not None:
+            return self.result["kernel"]
+        log = get_logger(self._log_child)
+        forced = self.forced()
+        if forced in ("pallas", "xla"):
+            self.result = {"kernel": forced, "how": "env override"}
+            return forced
+        smoke_ok = False
+        try:
+            smoke_ok = bool(self._smoke())
+        except Exception as e:  # noqa: BLE001 — any smoke crash degrades
+            log.warning(
+                "%s pallas smoke raised (%s: %s); kernel=auto degrades to "
+                "xla", self.name, type(e).__name__, str(e)[:200])
+        if not smoke_ok:
+            self.result = {"kernel": "xla", "how": "pallas smoke failed"}
+            return "xla"
+        cache_key = self.cache_key()
+        cached = self._load_cached(cache_key)
+        if cached is not None:
+            self.result = {**cached, "how": "disk cache"}
+            log.info("%s kernel=auto -> %s (cached election: %s)",
+                     self.name, cached["kernel"], cached.get("score"))
+            return cached["kernel"]
+        try:
+            score = {k: round(float(self._measure(k)), 2)
+                     for k in ("xla", "pallas")}
+            kernel = max(score, key=score.get)
+        except Exception as e:  # noqa: BLE001 — a bench failure must not
+            # take down every gather/sample; fall back to the safe default
+            log.warning("%s kernel election failed (%s: %s); auto -> xla",
+                        self.name, type(e).__name__, str(e)[:200])
+            self.result = {"kernel": "xla", "how": "election failed"}
+            return "xla"
+        self.result = {"kernel": kernel, "score": score,
+                       "key": cache_key, "how": "measured"}
+        log.info("%s kernel=auto -> %s (measured %s: %s)",
+                 self.name, kernel, self.unit, score)
+        self._store({"kernel": kernel, "score": score, "key": cache_key})
+        return kernel
+
+    def resolve_request(self, kernel: str) -> str:
+        """Resolve a kernel request. Touches the backend, so callers defer
+        this to first use (never the constructor)."""
+        validate_kernel_arg(kernel)
+        if kernel != "auto":
+            return kernel
+        try:
+            backend = jax.default_backend()
+        except RuntimeError:
+            return "xla"
+        if backend != "tpu":
+            return "xla"
+        return self.elect()
+
+    def reset(self) -> None:
+        """Test seam: forget the in-process decision and the pinned env
+        force, as a fresh process would."""
+        self.result = None
+        self._forced = None

@@ -1,102 +1,21 @@
-"""Pallas CSR neighbor-sampling kernel (windowed row DMA).
+"""Windowed Pallas CSR sampler — compatibility front for the fused engine.
 
-TPU-native counterpart of the reference's warp-per-row reservoir kernel
-(torch-quiver cuda_random.cu.hpp:7-69 ``CSRRowWiseSampleKernel``). The GPU
-kernel issues k random cache-line loads per row; TPUs want contiguous DMA,
-so the design flips to **window sampling**:
-
- 1. XLA precomputes per row a random aligned window into the neighbor span
-    and k distinct stratified offsets within it (shared math:
-    ops.sample.stratified_offsets).
- 2. The kernel DMAs ``indices[start : start+window]`` into VMEM — one bulk
-    DMA per row, all rows of a tile in flight at once (the DMA engines play
-    the role of the GPU's coalesced warp loads).
- 3. Selection is an exact integer one-hot masked-sum on the VPU (no float
-    round-trip, so node ids beyond 2^24 stay exact).
-
-Distribution: rows with deg <= window are *identical in distribution* to the
-XLA sampler (window = whole row, same strata). Rows with deg > window sample
-from a uniformly-placed contiguous window: slot p's marginal is
-``n(p)/T * k/window`` with ``T = deg-window+1`` placements and
-``n(p) = min(p, T-1) - max(p-window+1, 0) + 1`` — interior slots boosted by
-``deg/T`` over the exact ``k/deg``, the first/last (window-1) slots
-attenuated linearly toward the row ends. With the default window 2048 this
-affects the <0.1% power-law tail.
-
-Policy (decided r5, pinned by tests/test_pallas_hub_distribution.py): the
-hub-row attenuation is ACCEPTED rather than patched with multi-window
-draws — ``kernel='pallas'`` is an explicit opt-in, and the exact XLA path
-remains the default and the correctness reference (the reference's
-reservoir kernel, cuda_random.cu.hpp:41-57, is exact at any degree).
+The original single-purpose windowed kernel grew into the fused per-hop
+megakernel in ``ops/pallas/fused.py`` (weighted inverse-CDF walk, temporal
+windows, eid lanes, dist owner-side select — one audited engine behind
+every sampler variant). This module keeps the historical entry point:
+``sample_layer_windowed`` is the fused engine's uniform path, unchanged in
+contract, and now BITWISE equal to ``ops.sample.sample_layer`` for rows
+with ``deg <= window`` (the fused engine adopted the oracle's 2-way key
+split; see fused.py's parity contract and the hub-row attenuation policy
+for ``deg > window``).
 """
 
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-from ..sample import rotate_offsets, stratified_offsets
+from .fused import DEFAULT_WINDOW, fused_sample_layer
 
 __all__ = ["sample_layer_windowed", "DEFAULT_WINDOW"]
-
-# default neighbor-window length; callers deciding between this kernel and
-# the XLA path compare edge_count against it (quiver_tpu/sampling/sampler.py)
-DEFAULT_WINDOW = 2048
-
-
-def _kernel(tile: int, window: int, k: int,
-            start_ref, indices_ref, offs_ref, out_ref, buf, sems):
-    i = pl.program_id(0)
-
-    def dma(j):
-        return pltpu.make_async_copy(
-            indices_ref.at[pl.ds(start_ref[i * tile + j], window)],
-            buf.at[j],
-            sems.at[j],
-        )
-
-    # fan out: all row-window DMAs of this tile in flight at once
-    for j in range(tile):
-        dma(j).start()
-    for j in range(tile):
-        dma(j).wait()
-
-    # exact integer select: out[j, c] = buf[j, offs[j, c]]
-    col = jax.lax.broadcasted_iota(jnp.int32, (tile, k, window), 2)
-    offs = offs_ref[:, :]
-    hit = col == offs[:, :, None]
-    vals = buf[:, :].reshape(tile, 1, window)
-    out_ref[:, :] = jnp.sum(jnp.where(hit, vals, 0), axis=2)
-
-
-@functools.partial(jax.jit, static_argnames=("tile", "window", "k", "interpret"))
-def _run(indices, start, offs, tile, window, k, interpret):
-    Sp = start.shape[0]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # start addresses
-        grid=(Sp // tile,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # indices stay in HBM
-            pl.BlockSpec((tile, k), lambda i, *_: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (tile, k), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((tile, window), jnp.int32),
-            pltpu.SemaphoreType.DMA((tile,)),
-        ],
-    )
-    return pl.pallas_call(
-        functools.partial(_kernel, tile, window, k),
-        out_shape=jax.ShapeDtypeStruct((Sp, k), jnp.int32),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(start, indices, offs)
 
 
 def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
@@ -105,67 +24,12 @@ def sample_layer_windowed(topo, seeds, num_seeds, k: int, key,
     """Windowed Pallas sampling; same (S, K)/-1 padded contract as
     ops.sample.sample_layer.
 
-    Requires an HBM-resident int32 ``indices`` with edge_count >= window
-    (callers fall back to the XLA path otherwise).
+    Requires an HBM-resident topology with edge_count >= window (callers
+    fall back to the XLA path otherwise). Uniform draws only — the fused
+    engine (ops/pallas/fused.py fused_sample_layer) adds the weighted,
+    temporal, and eid lanes.
     """
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    E = topo.indices.shape[0]
-    if E < window:
-        raise ValueError(f"edge_count {E} < window {window}; use the XLA path")
-    if E - window > jnp.iinfo(jnp.int32).max:
-        # window starts ride scalar-prefetch SMEM as int32; past 2^31 edges
-        # they would wrap (the XLA path keeps indptr dtype and stays exact)
-        raise ValueError(
-            f"edge_count {E} exceeds the int32 windowed-DMA range; "
-            "use the XLA path"
-        )
-    if k > window:
-        # counts reports min(deg, k); with k > window only `window` lanes
-        # could ever be valid and counts would overstate them
-        raise ValueError(f"fanout k={k} must be <= window={window}")
-
-    S = seeds.shape[0]
-    valid = (jnp.arange(S) < num_seeds) & (seeds >= 0)
-    s = jnp.where(valid, seeds, 0)
-    # jnp view of indptr: a host-numpy indptr indexed by a traced ``s``
-    # raises TracerArrayConversionError, so the windowed path silently
-    # lost its jit/lowering story (caught by graftaudit's pallas target)
-    indptr = jnp.asarray(topo.indptr)
-    base = indptr[s]  # keep indptr dtype: values can exceed int32 ranges
-    deg = (indptr[s + 1] - base).astype(jnp.int32)
-    deg = jnp.where(valid, deg, 0)
-
-    kr, kj, kw = jax.random.split(key, 3)
-    # window placement: whole row when it fits, else uniform aligned window
-    max_start = jnp.maximum(deg - window, 0)
-    r = jax.random.randint(kr, (S,), 0, max_start + 1, dtype=jnp.int32)
-    wlen = jnp.minimum(deg, window)
-    # distinct offsets within the window (deg<=k rows: take-all, CSR order),
-    # plus a uniform rotation so marginals are exactly k/wlen even when
-    # wlen % k != 0 (same construction as the XLA path)
-    offs, sel_mask = stratified_offsets(kj, wlen, k)
-    offs = rotate_offsets(kw, offs, wlen, k)
-
-    # window never leaves the array (computed in indptr dtype, cast only
-    # after the clip bounds it under 2^31 — checked above)
-    start_wide = jnp.clip(base + r.astype(base.dtype), 0, E - window)
-    # the clip can shift a tail-of-array row's window left of base+r; the
-    # offsets then still land inside the row because offs < wlen <= deg
-    off_base = ((base + r.astype(base.dtype)) - start_wide).astype(jnp.int32)
-    start = start_wide.astype(jnp.int32)
-    offs = offs + off_base[:, None]
-
-    pad = (-S) % tile
-    if pad:
-        start = jnp.concatenate([start, jnp.zeros(pad, start.dtype)])
-        offs = jnp.concatenate([offs, jnp.zeros((pad, k), offs.dtype)])
-
-    nbr = _run(
-        topo.indices.astype(jnp.int32), start, offs, tile, window, k, interpret
-    )[:S]
-
-    mask = valid[:, None] & sel_mask
-    nbr = jnp.where(mask, nbr, -1)
-    counts = jnp.where(valid, jnp.minimum(deg, k), 0)
-    return nbr, counts
+    return fused_sample_layer(
+        topo, seeds, num_seeds, k, key, window=window, tile=tile,
+        interpret=interpret,
+    )

@@ -64,7 +64,7 @@ from ..ops.reindex import reindex_layer, resolve_dedup
 from ..ops.sample import rotate_offsets, stratified_offsets
 from ..parallel.mesh import FEATURE_AXIS, shard_map
 from ..parallel.routing import BucketRoute
-from ..utils.trace import trace_scope
+from ..utils.trace import info_once, trace_scope
 from .sampler import Adj, GraphSageSampler, SampleOutput, _round_up
 
 __all__ = [
@@ -105,7 +105,8 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
                       num_shards: int, cap: int | None,
                       weighted: bool = False, local_cum_weights=None,
                       time_window=None, local_edge_time=None,
-                      search_iters: int = 0, route=None):
+                      search_iters: int = 0, route=None,
+                      kernel: str = "xla"):
     """One distributed hop (per-device body; call inside ``shard_map``).
 
     Args:
@@ -134,6 +135,15 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
         (the hetero sampler shares ONE route per destination type across
         every relation into it — the plan's id lanes are sent once and
         cached). ``None`` builds a fresh route.
+      kernel: "xla" or "pallas" — with "pallas" the OWNER-side neighbor
+        gather and weighted CDF walk run on the fused Pallas engine
+        (ops/pallas/fused.py ``fused_select_hop``/``fused_weighted_hop``;
+        the same audited kernel as the replicated sampler), and every bit
+        crossing the wires is unchanged, so the parity contract holds.
+        Callers must guarantee ``window <= E_local <= int32 max`` and that
+        every row fits one DMA window (global ``max_degree <= window``) —
+        ``DistGraphSageSampler._compiled`` gates this and degrades to xla;
+        direct callers that break it get a loud ValueError.
 
     Returns (neighbors (S, k) int32 -1-masked, counts (S,), overflow
     scalar — the axis-group total of fallback-served lanes).
@@ -148,6 +158,23 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
     base_dtype = (
         jnp.int64 if E_local > np.iinfo(np.int32).max else jnp.int32
     )
+    use_pallas = kernel == "pallas"
+    if use_pallas:
+        from ..ops.pallas.fused import (
+            DEFAULT_WINDOW,
+            fused_select_hop,
+            fused_weighted_hop,
+        )
+
+        if (E_local < DEFAULT_WINDOW
+                or E_local > np.iinfo(np.int32).max
+                or k > DEFAULT_WINDOW):
+            raise ValueError(
+                f"kernel='pallas' needs {DEFAULT_WINDOW} <= local edge "
+                f"count <= int32 max and fanout <= {DEFAULT_WINDOW} (got "
+                f"E_local={E_local}, k={k}); DistGraphSageSampler gates "
+                f"this at compile time — use kernel='xla' here"
+            )
 
     def _mine_local(ids):
         # ownership-masked local row index — zero answers for lanes this
@@ -168,8 +195,24 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
     def serve_nbr(ids, offs):
         mine, r = _mine_local(ids)
         base, _ = _local_row(r)
-        epos = base[:, None] + offs.astype(base.dtype)
-        nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
+        if use_pallas:
+            # fused owner-side gather: one window DMA per routed lane +
+            # in-kernel one-hot select. Callers guarantee every owned row
+            # fits the window (global max_degree <= window) and offs <
+            # deg, so start = clip(base) keeps base+offs in-window; lanes
+            # this shard does not own read row 0's window (in-bounds, any
+            # value) and are zero-masked below, exactly like the clipped
+            # XLA gather — the bits after the mask are identical.
+            start = jnp.clip(
+                base, 0, E_local - DEFAULT_WINDOW).astype(jnp.int32)
+            woffs = offs.astype(jnp.int32) + (
+                base.astype(jnp.int32) - start)[:, None]
+            (nbr,) = fused_select_hop(
+                local_indices.astype(jnp.int32), start, woffs,
+                window=DEFAULT_WINDOW)
+        else:
+            epos = base[:, None] + offs.astype(base.dtype)
+            nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
         return jnp.where(mine[:, None], nbr, 0).astype(jnp.int32)
 
     if route is None:
@@ -196,16 +239,32 @@ def dist_sample_layer(local_indptr, local_indices, rows_per_shard: int,
         def serve_wnbr(ids, u):
             mine, r = _mine_local(ids)
             base, deg = _local_row(r)
-            off = _cdf_search(local_cum_weights, u, base, deg, search_iters)
-            i = jnp.arange(k, dtype=jnp.int32)[None, :]
-            degc = deg[:, None]
-            # the replicated kernel's take-all override (weighted_offsets):
-            # local deg equals global deg, so this matches exactly
-            off = jnp.where(
-                degc <= k, jnp.minimum(i, jnp.maximum(degc - 1, 0)), off
-            )
-            epos = base[:, None] + off.astype(base.dtype)
-            nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
+            if use_pallas:
+                # the fused in-kernel CDF walk is the affine shift of
+                # _cdf_search by the window start (see ops/pallas/fused.py
+                # for the probe-parity proof); u arrives pre-scaled by the
+                # tot exchange, so scale_u=False. The take-all override
+                # (local deg equals global deg) runs in-kernel.
+                start = jnp.clip(
+                    base, 0, E_local - DEFAULT_WINDOW).astype(jnp.int32)
+                off0 = (base - start.astype(base.dtype)).astype(jnp.int32)
+                nbr, _ = fused_weighted_hop(
+                    local_indices.astype(jnp.int32), local_cum_weights,
+                    start, off0, deg, u, search_iters, scale_u=False,
+                    window=DEFAULT_WINDOW)
+            else:
+                off = _cdf_search(
+                    local_cum_weights, u, base, deg, search_iters)
+                i = jnp.arange(k, dtype=jnp.int32)[None, :]
+                degc = deg[:, None]
+                # the replicated kernel's take-all override
+                # (weighted_offsets): local deg equals global deg, so
+                # this matches exactly
+                off = jnp.where(
+                    degc <= k, jnp.minimum(i, jnp.maximum(degc - 1, 0)), off
+                )
+                epos = base[:, None] + off.astype(base.dtype)
+                nbr = local_indices[jnp.clip(epos, 0, E_local - 1)]
             return jnp.where(mine[:, None], nbr, 0).astype(jnp.int32)
 
         deg = route.exchange(serve_deg)
@@ -263,7 +322,7 @@ def dist_multilayer_sample(local_indptr, local_indices, rows_per_shard: int,
                            dedup: str = "sort", node_count: int | None = None,
                            weighted: bool = False, local_cum_weights=None,
                            time_window=None, local_edge_time=None,
-                           search_iters: int = 0):
+                           search_iters: int = 0, kernel: str = "xla"):
     """Multi-layer distributed sample+reindex loop (per-device body).
 
     The sharded-topology twin of ``sampling.sampler.multilayer_sample`` —
@@ -290,7 +349,7 @@ def dist_multilayer_sample(local_indptr, local_indices, rows_per_shard: int,
                 sub, axis=axis, num_shards=num_shards, cap=cap,
                 weighted=weighted, local_cum_weights=local_cum_weights,
                 time_window=time_window, local_edge_time=local_edge_time,
-                search_iters=search_iters,
+                search_iters=search_iters, kernel=kernel,
             )
         hop_overflows.append(hop_ov)
         with trace_scope(f"reindex_layer_{l}"):
@@ -327,10 +386,15 @@ class DistGraphSageSampler(GraphSageSampler):
     row-local prefix-weight slices and the owner answers inverse-CDF
     draws — see ``dist_sample_layer``) and ``time_window`` (owner-answered
     in-window slot ranges) biased draws, each bit-identical to its
-    replicated counterpart. Constraints vs the replicated sampler: HBM
-    mode, the ``xla`` kernel, no ``with_eid`` (that path stays on the
-    replicated ``GraphSageSampler``; the sharded CSR slices do not carry
-    eid). ``routed_alpha`` is the shared capped-bucket routing budget —
+    replicated counterpart. The ``kernel`` knob matches the replicated
+    sampler too: with "pallas" (or an auto election landing there) the
+    owner-side gathers and weighted CDF walks run on the fused Pallas
+    engine — bits on the wire unchanged — degrading per compile to xla
+    (one INFO) when a shard's slice cannot host the window DMA.
+    Constraints vs the replicated sampler: HBM mode and no ``with_eid``
+    (that path stays on the replicated ``GraphSageSampler``; the sharded
+    CSR slices do not carry eid).
+    ``routed_alpha`` is the shared capped-bucket routing budget —
     ``cap = ceil(alpha * L / F)`` lanes per destination per hop; ``None``
     = uncapped full-length buckets. The ``DistributedTrainer`` drives this
     sampler and the sharded feature store with ONE alpha (one budget, one
@@ -354,7 +418,7 @@ class DistGraphSageSampler(GraphSageSampler):
         weighted: bool = False,
         time_window=None,
         auto_margin: float = 1.25,
-        kernel: str = "xla",
+        kernel: str = "auto",
         with_eid: bool = False,
         dedup: str = "auto",
         device_topo=None,
@@ -375,10 +439,6 @@ class DistGraphSageSampler(GraphSageSampler):
                 "with_eid over a sharded topology is not supported; the "
                 "sharded CSR slices do not carry eid — use the replicated "
                 "GraphSageSampler"
-            )
-        if str(kernel) != "xla":
-            raise ValueError(
-                f"topo_sharding='mesh' supports kernel='xla' only, got {kernel!r}"
             )
         if SampleMode.parse(mode) is not SampleMode.HBM:
             raise ValueError(
@@ -489,6 +549,35 @@ class DistGraphSageSampler(GraphSageSampler):
         time_window = self.time_window
         iters = self.topo.search_iters
         n_topo = len(self._topo_operands())
+        kernel = self.kernel  # resolved request (may run the election)
+        if kernel == "pallas":
+            from ..ops.pallas.fused import DEFAULT_WINDOW
+
+            # compile-time eligibility for the fused owner-side kernel:
+            # every shard's slice must host a full DMA window in int32
+            # range, and every row (global max_degree — offsets route to
+            # whichever shard owns the row) must fit one window
+            E_local = int(self.topo.indices.shape[1])
+            md = int(self.csr_topo.max_degree)
+            bad = None
+            if E_local < DEFAULT_WINDOW:
+                bad = (f"per-shard edge slices hold {E_local} edges, fewer "
+                       f"than the {DEFAULT_WINDOW}-edge DMA window")
+            elif E_local > np.iinfo(np.int32).max:
+                bad = f"per-shard edge slices exceed int32 range ({E_local})"
+            elif md > DEFAULT_WINDOW:
+                bad = (f"max_degree {md} exceeds the {DEFAULT_WINDOW}-slot "
+                       f"window (owner-side rows must fit one window)")
+            elif any(kf > DEFAULT_WINDOW for kf in sizes):
+                bad = (f"a fanout in {sizes} exceeds the "
+                       f"{DEFAULT_WINDOW}-slot window")
+            if bad is not None:
+                info_once(
+                    "dist-sample-pallas-degrade",
+                    "kernel='pallas' over the sharded topology falls back "
+                    "to the XLA path: %s", bad,
+                )
+                kernel = "xla"
 
         def body(*args):
             # args: indptr, indices, [cum_weights], [edge_time], seeds, key
@@ -506,7 +595,7 @@ class DistGraphSageSampler(GraphSageSampler):
                 dedup=dedup, node_count=n,
                 weighted=weighted, local_cum_weights=cum_blk,
                 time_window=time_window, local_edge_time=time_blk,
-                search_iters=iters,
+                search_iters=iters, kernel=kernel,
             )
             eis = tuple(a.edge_index for a in adjs)
             # per-worker scalar row: [n_count, frontier_overflow,

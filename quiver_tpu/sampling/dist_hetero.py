@@ -44,13 +44,14 @@ from ..core.config import SampleMode
 from ..core.hetero import HeteroCSRTopo
 from ..core.hetero_sharded import HeteroShardedTopology
 from ..obs.registry import HETERO_SAMPLE_OVERFLOW, MetricsRegistry
+from ..ops.election import validate_kernel_arg
 from ..ops.reindex import masked_unique
 from ..parallel.mesh import FEATURE_AXIS, shard_map
 from ..parallel.routing import BucketRoute
-from ..utils.trace import trace_scope
+from ..utils.trace import info_once, trace_scope
 from .dist import _worker_index, dist_sample_layer, routed_sample_cap
 from .hetero import HeteroGraphSampler, HeteroLayer, HeteroSampleOutput
-from .sampler import Adj, _round_up
+from .sampler import Adj, _round_up, resolve_sample_kernel
 
 __all__ = ["DistHeteroSampler", "dist_hetero_multilayer_sample"]
 
@@ -61,7 +62,8 @@ def dist_hetero_multilayer_sample(rel_blocks, seeds, num_seeds, key,
                                   routed_alpha: float | None = 2.0,
                                   weighted_rels=frozenset(),
                                   search_iters=None, node_bounds=None,
-                                  scatter_free: bool = False):
+                                  scatter_free: bool = False,
+                                  pallas_rels=frozenset()):
     """The per-device distributed hetero loop (call inside ``shard_map``).
 
     Args:
@@ -74,6 +76,10 @@ def dist_hetero_multilayer_sample(rel_blocks, seeds, num_seeds, key,
       rows_per_shard: {node_type: rows per shard} owner geometry.
       search_iters: {edge_type: static binary-search bound} for weighted
         relations (from each relation's GLOBAL max degree).
+      pallas_rels: relations whose owner-side hop runs on the fused
+        Pallas engine (``dist_sample_layer`` ``kernel="pallas"``; bits on
+        the wire unchanged). ``DistHeteroSampler._compiled`` gates each
+        relation on slice size / max degree / fanout vs the DMA window.
 
     Returns ``(frontier, counts, ei_layers, overflow, frontier_counts,
     hop_overflows)`` where ``ei_layers`` is deepest-first, each hop a tuple
@@ -115,6 +121,7 @@ def dist_hetero_multilayer_sample(rel_blocks, seeds, num_seeds, key,
                     sub, axis=axis, num_shards=num_shards, cap=None,
                     weighted=et in weighted_rels, local_cum_weights=cw,
                     search_iters=search_iters.get(et, 0), route=routes[d],
+                    kernel="pallas" if et in pallas_rels else "xla",
                 )
             samples[et] = nbr
         hop_overflows.append(tuple(
@@ -195,10 +202,13 @@ class DistHeteroSampler(HeteroGraphSampler):
 
     Extra args over the replicated sampler: ``mesh`` (required), the
     ``routed_alpha`` capped-bucket budget (``cap = ceil(alpha * S / F)``
-    lanes per destination per hop; ``None`` = uncapped), and ``axis`` (the
-    mesh axis the partitions live on). Constraints: HBM mode and no
-    ``with_eid`` (the sharded relation slices do not carry eid — that path
-    stays on the replicated sampler).
+    lanes per destination per hop; ``None`` = uncapped), ``axis`` (the
+    mesh axis the partitions live on), and ``kernel``
+    ("auto"|"pallas"|"xla" — with pallas, eligible relations' owner-side
+    hops run on the fused Pallas engine, per-relation compile-time gating
+    with one INFO per degrade; bits on the wire unchanged). Constraints:
+    HBM mode and no ``with_eid`` (the sharded relation slices do not
+    carry eid — that path stays on the replicated sampler).
 
     After an eager :meth:`sample`, ``last_sample_overflow`` holds the
     fallback-served lane count per (hop, edge type) — an int32
@@ -213,9 +223,12 @@ class DistHeteroSampler(HeteroGraphSampler):
                  auto_margin: float = 1.25, weighted=False,
                  with_eid: bool = False, dedup: str = "auto", *,
                  mesh=None, routed_alpha: float | None = 2.0,
-                 axis: str = FEATURE_AXIS):
+                 axis: str = FEATURE_AXIS, kernel: str = "auto"):
         if mesh is None:
             raise ValueError("DistHeteroSampler requires mesh=")
+        # the request rides verbatim; resolution (which may run the
+        # measured election) happens at first compile via the property
+        self._kernel = validate_kernel_arg(str(kernel))
         if with_eid:
             raise ValueError(
                 "with_eid over a sharded topology is not supported; the "
@@ -269,6 +282,16 @@ class DistHeteroSampler(HeteroGraphSampler):
             self.mesh, self.topo, axis=self.axis,
             weighted_rels=self.weighted_rels,
         )
+
+    @property
+    def kernel(self) -> str:
+        """The resolved sampler kernel ("pallas"|"xla") — same lazy
+        election contract as ``GraphSageSampler.kernel``."""
+        resolved = getattr(self, "_kernel_resolved", None)
+        if resolved is None:
+            resolved = resolve_sample_kernel(self._kernel)
+            self._kernel_resolved = resolved
+        return resolved
 
     @property
     def overflow_slots(self) -> tuple:
@@ -360,6 +383,37 @@ class DistHeteroSampler(HeteroGraphSampler):
         scatter_free = self.dedup == "scan"
         n_topo = len(self._topo_operands())
         out_types, fc_slots = self._scal_layout(plans)
+        pallas_rels = frozenset()
+        if self.kernel == "pallas":  # resolved (may run the election)
+            from ..ops.pallas.fused import DEFAULT_WINDOW
+
+            # per-relation compile-time eligibility for the fused
+            # owner-side kernel (same gates as the homogeneous sampler,
+            # applied to each relation's slice and global max degree)
+            kmax = {}
+            for active, _, _ in plans:
+                for et, kf in active.items():
+                    kmax[et] = max(kf, kmax.get(et, 0))
+            ok, degraded = set(), []
+            for et in rel_keys:
+                E_local = int(self.dev_topos.rels[et].indices.shape[1])
+                md = int(self.topo.relations[et].max_degree)
+                if (DEFAULT_WINDOW <= E_local <= np.iinfo(np.int32).max
+                        and md <= DEFAULT_WINDOW
+                        and kmax.get(et, 0) <= DEFAULT_WINDOW):
+                    ok.add(et)
+                else:
+                    degraded.append(et)
+            if degraded:
+                info_once(
+                    "dist-hetero-pallas-degrade",
+                    "kernel='pallas' falls back to the XLA path for "
+                    "relations %s: each needs a per-shard slice of at "
+                    "least %d edges (int32 range) with max_degree and "
+                    "fanout within the DMA window",
+                    sorted(degraded, key=str), DEFAULT_WINDOW,
+                )
+            pallas_rels = frozenset(ok)
 
         def body(*args):
             # args: per-relation (indptr, indices, [cum_weights]) blocks in
@@ -380,7 +434,7 @@ class DistHeteroSampler(HeteroGraphSampler):
                 axis=axis, num_shards=F, rows_per_shard=rps,
                 routed_alpha=alpha, weighted_rels=weighted_rels,
                 search_iters=iters, node_bounds=node_bounds,
-                scatter_free=scatter_free,
+                scatter_free=scatter_free, pallas_rels=pallas_rels,
             )
             # per-worker scalar row in the _scal_layout order
             scal = jnp.stack(

@@ -373,49 +373,46 @@ def _metrics_off():
 
 
 @_register(
-    "pallas_gather_interp",
-    "Pallas row-gather kernel, interpret-mode lowering (the "
-    "QUIVER_GATHER_KERNEL=pallas election path)",
-    sources=("quiver_tpu/ops/pallas/gather.py",),
-)
-def _pallas_gather():
-    import jax
-
-    from ...ops.pallas.gather import gather_rows
-
-    tbl = jax.ShapeDtypeStruct((64, 8), np.float32)
-    ids = jax.ShapeDtypeStruct((16,), np.int32)
-    return jax.jit(
-        lambda t, i: gather_rows(t, i, interpret=True)
-    ).trace(tbl, ids)
-
-
-@_register(
-    "pallas_sample_interp",
-    "Pallas windowed sampler, interpret-mode lowering (regression: the "
-    "host-numpy indptr indexing broke this trace entirely)",
-    sources=("quiver_tpu/ops/pallas/sample.py",),
+    "pallas_fused_interp",
+    "fused sample megakernel family, interpret-mode lowering in ONE "
+    "traced program: the uniform+eid hop over a host-numpy CSRTopo "
+    "closure (regression: host indptr indexing broke this trace "
+    "entirely), the weighted inverse-CDF hop, and the Pallas row gather "
+    "(the QUIVER_{SAMPLE,GATHER}_KERNEL=pallas election paths)",
+    sources=("quiver_tpu/ops/pallas/fused.py",
+             "quiver_tpu/ops/pallas/sample.py",
+             "quiver_tpu/ops/pallas/gather.py"),
     # the CSR topology rides the closure as trace constants — bounded at
-    # ~4KB here, and the production path passes topology as operands
+    # ~10KB here, and the production path passes topology as operands
     waivers={"constant-bloat": "fixture topology is closure-captured by "
                                "construction; production paths pass "
                                "topology operands"},
 )
-def _pallas_sample():
+def _pallas_fused():
     import jax
 
     from ...core.topology import CSRTopo
-    from ...ops.pallas.sample import sample_layer_windowed
+    from ...ops.pallas.fused import fused_sample_layer
+    from ...ops.pallas.gather import gather_rows
 
     rng = np.random.default_rng(0)
     ei = np.stack([rng.integers(0, 64, 900), rng.integers(0, 64, 900)])
     topo = CSRTopo(edge_index=ei)
+    topo.set_edge_weight(rng.random(900).astype(np.float32) + 0.1)
+    wtopo = topo.to_device(with_weights=True)
     seeds = jax.ShapeDtypeStruct((16,), np.int32)
     key = jax.ShapeDtypeStruct((2,), np.uint32)
-    return jax.jit(
-        lambda s, k: sample_layer_windowed(
-            topo, s, 16, 4, k, window=32, interpret=True)
-    ).trace(seeds, key)
+    tbl = jax.ShapeDtypeStruct((64, 8), np.float32)
+    ids = jax.ShapeDtypeStruct((16,), np.int32)
+
+    def program(s, k, t, i):
+        uni = fused_sample_layer(topo, s, 16, 4, k, with_eid=True,
+                                 window=128, interpret=True)
+        wei = fused_sample_layer(wtopo, s, 16, 4, k, weighted=True,
+                                 window=128, interpret=True)
+        return uni, wei, gather_rows(t, i, interpret=True)
+
+    return jax.jit(program).trace(seeds, key, tbl, ids)
 
 
 def _ladder():

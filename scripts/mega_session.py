@@ -75,6 +75,7 @@ ORDER = [
     ("epoch-pipelined", 1800),
     ("validation", 1200),
     ("sampler-pallas", 1200),
+    ("sampler-fused-pallas", 1200),
     ("sampler-host", 1200),
     ("feature-replicate-xla", 900),
     ("feature-bf16", 900),

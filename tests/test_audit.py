@@ -127,11 +127,11 @@ def test_changed_scoping_and_target_selection():
 
 
 def test_waivers_suppress_with_reason():
-    t = REGISTRY["pallas_sample_interp"]
+    t = REGISTRY["pallas_fused_interp"]
     assert "constant-bloat" in t.waivers  # reasoned registry-side waiver
-    result = run_audit(targets=["pallas_sample_interp"])
+    result = run_audit(targets=["pallas_fused_interp"])
     assert result.exit_code == 0
-    assert ("pallas_sample_interp", "constant-bloat",
+    assert ("pallas_fused_interp", "constant-bloat",
             t.waivers["constant-bloat"]) in result.waivers
 
 

@@ -196,6 +196,7 @@ def _trainer_run(controller):
     return losses, params, telemetry
 
 
+@pytest.mark.slow  # 19s; CI controller-smoke runs this by node id every push
 def test_frozen_controller_bitwise_parity():
     """An attached-but-frozen controller observes everything and decides
     nothing: loss trajectory, final params, and the standard telemetry

@@ -213,6 +213,7 @@ def _drive_reddit_shaped(root, n, avg_deg, steps, batch):
     return losses
 
 
+@pytest.mark.slow  # 14s reddit-shaped end-to-end flow
 def test_reddit_shaped_dims_flow_through_stack(tmp_path):
     """CI-scale: true feature dim / class count / npz dtypes, node count
     scaled to 12k so the suite stays fast."""

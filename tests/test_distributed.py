@@ -130,6 +130,7 @@ def test_fused_cold_tier_matches_full_hbm():
     np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # 31s pair; the replicated cold-tier parity stays fast
 @pytest.mark.parametrize("seed_sharding", ["data", "all"])
 def test_fused_sharded_cold_tier_matches_full(seed_sharding):
     """Mesh-sharded hot tier + pinned-host cold tier through the fused

@@ -214,6 +214,7 @@ class TestBenchInitWatchdog:
         assert err is None and not hung
         assert rec["metric"] == "sampled-edges/sec/chip"
 
+    @pytest.mark.slow  # 15s of real watchdog wall-clock by design
     def test_post_init_hang_is_a_timeout(self, bench_mod, monkeypatch):
         src = (
             "import sys, time;"

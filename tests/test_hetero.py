@@ -90,6 +90,7 @@ def test_hetero_sampler_contract():
     }
 
 
+@pytest.mark.slow  # 15s hetero 3-way dedup differential
 def test_hetero_dedup_alternatives_match_sort():
     """dedup='map' and dedup='scan' must reproduce dedup='sort' exactly
     across every node type's frontier and every relation's edge_index
@@ -306,6 +307,7 @@ def _powerlaw_schema(seed=0, n_paper=3000, n_author=1200):
     )
 
 
+@pytest.mark.slow  # 19s auto-caps sweep; overflow guards stay fast
 def test_hetero_auto_caps_right_size(  ):
     """VERDICT r1 item 7: auto caps within 1.5x of observed uniques on a
     power-law hetero graph, no overflow, and strictly tighter than the

@@ -1,24 +1,32 @@
 """kernel=auto election: by measured throughput, not compile success
-(VERDICT r3 item 4)."""
+(VERDICT r3 item 4) — now one shared ``ops.election.KernelElection``
+machinery behind both the gather (feature) and sample (fused megakernel)
+elections, with one nested disk-cache file (ISSUE 16 satellite 2)."""
 
 import json
 
 import pytest
 
+import quiver_tpu.ops.election as EL
 from quiver_tpu.feature import feature as F
+from quiver_tpu.sampling import sampler as S
 
 
 @pytest.fixture(autouse=True)
 def fresh_election(tmp_path, monkeypatch):
     # the election AND its env knobs are resolved once per process
-    # (env-before-first-use); tests reset all three caches to re-resolve
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
-    monkeypatch.setattr(F, "_ELECTION_CACHE_PATH", None)
-    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
+    # (env-before-first-use); tests reset all the caches to re-resolve
+    monkeypatch.setattr(EL, "_ELECTION_CACHE_PATH", None)
     monkeypatch.setenv("QUIVER_ELECTION_CACHE",
                        str(tmp_path / "election.json"))
     monkeypatch.delenv("QUIVER_GATHER_KERNEL", raising=False)
+    monkeypatch.delenv("QUIVER_SAMPLE_KERNEL", raising=False)
+    F.GATHER_ELECTION.reset()
+    S.SAMPLE_ELECTION.reset()
     yield tmp_path / "election.json"
+    # leave the module-level singletons as a fresh process would find them
+    F.GATHER_ELECTION.reset()
+    S.SAMPLE_ELECTION.reset()
 
 
 def test_measure_gather_gbps_runs():
@@ -26,22 +34,33 @@ def test_measure_gather_gbps_runs():
     assert gbps > 0
 
 
-def test_election_picks_measured_winner(fresh_election, monkeypatch):
-    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
-    monkeypatch.setattr(
-        F, "_measure_gather_gbps",
-        lambda k, **kw: {"xla": 10.0, "pallas": 4.0}[k])
-    assert F._elect_gather_kernel() == "xla"
-    assert F._GATHER_ELECTION["how"] == "measured"
+def test_measure_sample_eps_runs():
+    eps = S._measure_sample_eps("xla", nodes=64, edges=512, batch=16,
+                                k=4, reps=2)
+    assert eps > 0
+
+
+@pytest.mark.parametrize("which", ["gather", "sample"])
+def test_election_picks_measured_winner(which, fresh_election, monkeypatch):
+    mod, elec = ((F, F.GATHER_ELECTION) if which == "gather"
+                 else (S, S.SAMPLE_ELECTION))
+    smoke = ("_pallas_gather_usable" if which == "gather"
+             else "_pallas_sample_usable")
+    meas = ("_measure_gather_gbps" if which == "gather"
+            else "_measure_sample_eps")
+    monkeypatch.setattr(mod, smoke, lambda: True)
+    monkeypatch.setattr(mod, meas,
+                        lambda k, **kw: {"xla": 10.0, "pallas": 4.0}[k])
+    assert elec.elect() == "xla"
+    assert elec.result["how"] == "measured"
     # and the loser would have won with the numbers flipped
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
-    monkeypatch.setattr(F, "_ELECTION_CACHE_PATH", None)
+    elec.reset()
+    monkeypatch.setattr(EL, "_ELECTION_CACHE_PATH", None)
     monkeypatch.setenv("QUIVER_ELECTION_CACHE",
                        str(fresh_election.parent / "election2.json"))
-    monkeypatch.setattr(
-        F, "_measure_gather_gbps",
-        lambda k, **kw: {"xla": 4.0, "pallas": 10.0}[k])
-    assert F._elect_gather_kernel() == "pallas"
+    monkeypatch.setattr(mod, meas,
+                        lambda k, **kw: {"xla": 4.0, "pallas": 10.0}[k])
+    assert elec.elect() == "pallas"
 
 
 def test_election_disk_cache_roundtrip(fresh_election, monkeypatch):
@@ -49,29 +68,55 @@ def test_election_disk_cache_roundtrip(fresh_election, monkeypatch):
     monkeypatch.setattr(
         F, "_measure_gather_gbps",
         lambda k, **kw: {"xla": 1.0, "pallas": 9.0}[k])
-    assert F._elect_gather_kernel() == "pallas"
-    cached = json.loads(fresh_election.read_text())
-    assert cached["kernel"] == "pallas" and cached["gbps"]["pallas"] == 9.0
+    assert F.GATHER_ELECTION.elect() == "pallas"
+    blob = json.loads(fresh_election.read_text())
+    cached = blob["gather"]  # nested by election name (one shared file)
+    assert cached["kernel"] == "pallas" and cached["score"]["pallas"] == 9.0
 
-    # a fresh process (reset global) must trust the cache, not re-measure
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    # a fresh process (reset memo) must trust the cache, not re-measure
+    F.GATHER_ELECTION.reset()
 
     def boom(k, **kw):
         raise AssertionError("re-measured despite disk cache")
 
     monkeypatch.setattr(F, "_measure_gather_gbps", boom)
-    assert F._elect_gather_kernel() == "pallas"
-    assert F._GATHER_ELECTION["how"] == "disk cache"
+    assert F.GATHER_ELECTION.elect() == "pallas"
+    assert F.GATHER_ELECTION.result["how"] == "disk cache"
 
     # ...but a different cache key (device kind / jax version / kernel
     # revision) invalidates it
     cached["key"] = "rev0-jaxother-chip"
-    fresh_election.write_text(json.dumps(cached))
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
+    fresh_election.write_text(json.dumps({"gather": cached}))
+    F.GATHER_ELECTION.reset()
     monkeypatch.setattr(
         F, "_measure_gather_gbps",
         lambda k, **kw: {"xla": 9.0, "pallas": 1.0}[k])
-    assert F._elect_gather_kernel() == "xla"
+    assert F.GATHER_ELECTION.elect() == "xla"
+
+
+def test_shared_cache_holds_both_elections(fresh_election, monkeypatch):
+    """One file, nested by election name — the gather and sample entries
+    coexist, and a pre-generalization FLAT gather cache pointed at by
+    QUIVER_ELECTION_CACHE is tolerated (ignored, then rewritten nested)."""
+    # legacy flat format from before the ops/election.py refactor
+    fresh_election.write_text(json.dumps(
+        {"kernel": "pallas", "gbps": {"pallas": 9.0, "xla": 1.0},
+         "key": "rev1-jaxold-chip"}))
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 2.0, "pallas": 8.0}[k])
+    monkeypatch.setattr(S, "_pallas_sample_usable", lambda: True)
+    monkeypatch.setattr(
+        S, "_measure_sample_eps",
+        lambda k, **kw: {"xla": 7.0, "pallas": 3.0}[k])
+    assert F.GATHER_ELECTION.elect() == "pallas"  # flat file not trusted
+    assert F.GATHER_ELECTION.result["how"] == "measured"
+    assert S.SAMPLE_ELECTION.elect() == "xla"
+    blob = json.loads(fresh_election.read_text())
+    assert blob["gather"]["kernel"] == "pallas"
+    assert blob["sample"]["kernel"] == "xla"
+    assert "gbps" not in blob  # legacy keys dropped on rewrite
 
 
 def test_env_knobs_pinned_at_first_use(fresh_election, monkeypatch):
@@ -81,42 +126,65 @@ def test_env_knobs_pinned_at_first_use(fresh_election, monkeypatch):
     rule enforces repo-wide (chip-window forcing must precede the first
     gather)."""
     monkeypatch.setenv("QUIVER_GATHER_KERNEL", "xla")
-    assert F._forced_gather_kernel() == "xla"
-    first_path = F._election_cache_path()
+    assert F.GATHER_ELECTION.forced() == "xla"
+    first_path = EL._election_cache_path()
     assert first_path == str(fresh_election)
     # post-first-use flips are inert...
     monkeypatch.setenv("QUIVER_GATHER_KERNEL", "pallas")
     monkeypatch.setenv("QUIVER_ELECTION_CACHE",
                        str(fresh_election.parent / "other.json"))
-    assert F._forced_gather_kernel() == "xla"
-    assert F._election_cache_path() == first_path
+    assert F.GATHER_ELECTION.forced() == "xla"
+    assert EL._election_cache_path() == first_path
     # ...including through the election itself
-    assert F._elect_gather_kernel() == "xla"
-    assert F._GATHER_ELECTION["how"] == "env override"
+    assert F.GATHER_ELECTION.elect() == "xla"
+    assert F.GATHER_ELECTION.result["how"] == "env override"
     # a cache reset (= a fresh process) re-reads the env
-    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
-    assert F._forced_gather_kernel() == "pallas"
+    F.GATHER_ELECTION.reset()
+    assert F.GATHER_ELECTION.forced() == "pallas"
 
 
 def test_election_env_override_and_failsafes(fresh_election, monkeypatch):
-    monkeypatch.setenv("QUIVER_GATHER_KERNEL", "xla")
-    assert F._elect_gather_kernel() == "xla"
-    assert F._GATHER_ELECTION["how"] == "env override"
+    # the sample election rides the same failsafe ladder as gather
+    monkeypatch.setenv("QUIVER_SAMPLE_KERNEL", "xla")
+    assert S.SAMPLE_ELECTION.elect() == "xla"
+    assert S.SAMPLE_ELECTION.result["how"] == "env override"
 
     # failed pallas smoke short-circuits to xla without measuring
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
-    monkeypatch.setattr(F, "_FORCED_GATHER_KERNEL", None)
-    monkeypatch.delenv("QUIVER_GATHER_KERNEL")
-    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: False)
-    assert F._elect_gather_kernel() == "xla"
+    S.SAMPLE_ELECTION.reset()
+    monkeypatch.delenv("QUIVER_SAMPLE_KERNEL")
+    monkeypatch.setattr(S, "_pallas_sample_usable", lambda: False)
+
+    def never(k, **kw):
+        raise AssertionError("measured despite failed smoke")
+
+    monkeypatch.setattr(S, "_measure_sample_eps", never)
+    assert S.SAMPLE_ELECTION.elect() == "xla"
+    assert S.SAMPLE_ELECTION.result["how"] == "pallas smoke failed"
 
     # a measurement crash degrades to xla instead of raising
-    monkeypatch.setattr(F, "_GATHER_ELECTION", None)
-    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+    S.SAMPLE_ELECTION.reset()
+    monkeypatch.setattr(S, "_pallas_sample_usable", lambda: True)
 
     def boom(k, **kw):
         raise RuntimeError("chip went away")
 
-    monkeypatch.setattr(F, "_measure_gather_gbps", boom)
-    assert F._elect_gather_kernel() == "xla"
-    assert F._GATHER_ELECTION["how"] == "election failed"
+    monkeypatch.setattr(S, "_measure_sample_eps", boom)
+    assert S.SAMPLE_ELECTION.elect() == "xla"
+    assert S.SAMPLE_ELECTION.result["how"] == "election failed"
+
+
+def test_resolve_passthrough_and_off_tpu(monkeypatch):
+    """Explicit kernels bypass the election entirely; auto off-TPU is xla
+    without running smoke or measure (the CPU interpret path is correct
+    but slow)."""
+    def never():
+        raise AssertionError("smoke ran for an explicit/off-TPU resolve")
+
+    monkeypatch.setattr(S, "_pallas_sample_usable", never)
+    monkeypatch.setattr(S, "_measure_sample_eps",
+                        lambda k, **kw: never())
+    assert S.resolve_sample_kernel("pallas") == "pallas"
+    assert S.resolve_sample_kernel("xla") == "xla"
+    assert S.resolve_sample_kernel("auto") == "xla"  # CPU test runner
+    with pytest.raises(ValueError, match="kernel"):
+        S.resolve_sample_kernel("nope")

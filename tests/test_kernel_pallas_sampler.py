@@ -66,12 +66,30 @@ def test_pallas_kernel_per_row_distinct(topo):
 
 
 def test_pallas_kernel_guards(topo):
+    # the fused engine serves every VARIANT (weighted/temporal/with_eid);
+    # only the structural constraints still raise on explicit pallas
     with pytest.raises(ValueError, match="HBM"):
         GraphSageSampler(topo, [3], mode="UVA", kernel="pallas")
-    with pytest.raises(ValueError, match="unweighted"):
-        GraphSageSampler(topo, [3], weighted=True, kernel="pallas")
     with pytest.raises(ValueError, match="kernel"):
         GraphSageSampler(topo, [3], kernel="cuda")
+    # weighted + pallas constructs (and still validates its weight inputs)
+    with pytest.raises(ValueError, match="edge weights"):
+        GraphSageSampler(topo, [3], weighted=True, kernel="pallas")
+
+
+def test_pallas_kernel_weighted_runs(topo):
+    """The old capability-matrix raise is gone: weighted + kernel='pallas'
+    samples (bitwise differentials live in test_fused_sampler.py)."""
+    rng = np.random.default_rng(7)
+    wtopo = CSRTopo(edge_index=np.stack([
+        np.asarray(rng.integers(0, 400, 6000)),
+        np.asarray(rng.integers(0, 400, 6000)),
+    ]))
+    wtopo.set_edge_weight(rng.random(6000).astype(np.float32))
+    s = GraphSageSampler(wtopo, [4], seed_capacity=32, seed=0,
+                         kernel="pallas", weighted=True)
+    out = s.sample(np.arange(32))
+    assert int(out.n_count) >= 32
 
 
 def test_pallas_kernel_auto_caps_compose(topo):
@@ -84,11 +102,23 @@ def test_pallas_kernel_auto_caps_compose(topo):
     assert out2.n_id.shape[0] <= out1.n_id.shape[0]
 
 
-def test_pallas_kernel_small_graph_fallback():
-    """Graphs with fewer edges than the DMA window fall back to the XLA path."""
+def test_pallas_kernel_small_graph_fallback(caplog):
+    """Graphs with fewer edges than the DMA window fall back to the XLA
+    path — and say so ONCE (the silent trace-time switch grew an info_once
+    signal, same discipline as the other degrade paths)."""
+    import logging
+
+    from quiver_tpu.utils.trace import reset_once
+
+    reset_once()
     rng = np.random.default_rng(0)
     ei = rng.integers(0, 30, size=(2, 200)).astype(np.int64)  # E=200 < 2048
     small = CSRTopo(edge_index=ei)
     s = GraphSageSampler(small, [3], seed_capacity=16, seed=0, kernel="pallas")
-    out = s.sample(np.arange(16))
+    with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+        out = s.sample(np.arange(16))
+        s.sample(np.arange(16))  # second call: the log must NOT repeat
     assert int(out.n_count) >= 16
+    hits = [r for r in caplog.records
+            if "falls back to the XLA path" in r.getMessage()]
+    assert len(hits) == 1

@@ -275,6 +275,7 @@ def test_ledger_metrics_artifact(tmp_path, monkeypatch):
 # -- profiler bracketing ------------------------------------------------------
 
 
+@pytest.mark.slow  # 15s profiled epoch
 def test_profile_epoch_brackets_and_restores(tmp_path):
     prev = trace._enabled
     trace.disable_trace()

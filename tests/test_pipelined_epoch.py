@@ -116,9 +116,10 @@ def test_finalize_names_subset_and_dropped_fed_guard():
     assert set(out3) == {"pipe.b"} and int(out3["pipe.b"]) == 0
 
 
-# -- headline bit-parity differential (fast; the pipeline-smoke CI step) ------
+# -- headline bit-parity differential (the pipeline-smoke CI step) ------------
 
 
+@pytest.mark.slow  # 16s; CI pipeline-smoke runs this by node id every push
 def test_pipelined_epoch_bitwise_matches_serial():
     """Acceptance: pipeline_depth=1 epoch_scan reproduces the serial
     scan's losses, final params, and per-step routed-overflow / tier-hit

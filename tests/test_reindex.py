@@ -4,6 +4,7 @@ Oracle: the hash-map reference in ops/cpu_ref.py (parity with the reference's
 reindex_group, quiver.cpp:39-84).
 """
 
+import pytest
 import numpy as np
 import jax.numpy as jnp
 
@@ -76,6 +77,7 @@ def test_masked_unique_random_vs_python():
                 assert la[p] == -1
 
 
+@pytest.mark.slow  # 37s 3-way differential; map/scan spot checks stay fast
 def test_masked_unique_alternatives_match_sort():
     """The sort-free dense-map dedup (node_bound) AND the zero-scatter scan
     dedup must be bit-identical to the sort path on every output, across

@@ -4,6 +4,7 @@ landed it, SURVEY §2.5 — here it must actually work).
 Oracle: numpy induced-subgraph construction.
 """
 
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -172,6 +173,7 @@ def test_device_node_draw_matches_host_distribution():
     assert hi > lo
 
 
+@pytest.mark.slow  # 15s end-to-end training witness
 def test_saint_training_beats_feature_bayes():
     """End-to-end acceptance (the SAINT analogue of
     test_datasets.test_acceptance_sage_beats_feature_bayes): SAINT-subgraph

@@ -158,10 +158,17 @@ def test_eid_none_without_flag():
     assert all(adj.e_id is None for adj in out.adjs)
 
 
-def test_eid_rejected_with_pallas_kernel():
-    import pytest
-
+def test_eid_with_pallas_kernel():
+    # with_eid + pallas rides the fused engine now (PR 16): the eid lane
+    # comes back aligned with edge_index (bitwise differentials vs the
+    # XLA oracle live in test_fused_sampler.py)
     ei = generate_pareto_graph(300, 6.0, seed=2)
     topo = CSRTopo(edge_index=ei)
-    with pytest.raises(ValueError, match="with_eid"):
-        GraphSageSampler(topo, [4], kernel="pallas", with_eid=True)
+    s = GraphSageSampler(topo, [4], kernel="pallas", with_eid=True,
+                         seed_capacity=16)
+    out = s.sample(np.arange(16))
+    for adj in out.adjs:
+        assert adj.e_id is not None
+        src = np.asarray(adj.edge_index)[0]
+        eids = np.asarray(adj.e_id)
+        assert np.array_equal(eids >= 0, src >= 0)

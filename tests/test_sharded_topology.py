@@ -248,9 +248,11 @@ def test_mesh_sharding_constructor_guards():
     with pytest.raises(ValueError, match="with_eid over a sharded"):
         GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
                          with_eid=True)
+    # kernel='pallas' over mesh now rides the fused engine (PR 16); only
+    # an unknown kernel name still raises
     with pytest.raises(ValueError, match="kernel"):
         GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
-                         kernel="pallas")
+                         kernel="cuda")
     with pytest.raises(ValueError, match="HBM"):
         GraphSageSampler(topo, [4], topo_sharding="mesh", mesh=mesh,
                          mode="HOST")
