@@ -17,6 +17,16 @@ additionally asserts a sample of ladder responses bitwise against the
 direct single-query oracle — the CI serve-smoke gate. No reference
 baseline exists (the reference never served online); this row tracks the
 framework's own capability.
+
+``--fleet N`` switches to the open-loop FLEET lane (the ``serve-fleet``
+row): N :class:`~quiver_tpu.serving.ServingFleet` replicas share one
+feature store and one persisted AOT-executable cache (``--aot-cache``),
+traffic is a gold/bronze SLO-class mix (``--gold-frac``) with per-class
+deadlines, and the row reports per-class p99 vs per-class SLO, shed
+counts, cold-start-to-first-response (cache cold vs warm joins), and
+``recompiles_steady`` asserted 0. ``--expect-warm`` additionally asserts
+the FIRST replica warmed entirely from the cache (zero compiles) — the
+fresh-process restart gate CI's fleet-smoke job drives.
 """
 
 import time
@@ -45,6 +55,21 @@ def main():
     p.add_argument("--parity", action="store_true",
                    help="assert a sample of responses bitwise against the "
                    "direct single-query oracle (CI smoke gate)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run the open-loop fleet lane with this many "
+                   "replicas sharing one AOT cache (0 = single server)")
+    p.add_argument("--aot-cache", default=None,
+                   help="persisted AOT-executable cache directory shared "
+                   "by the fleet (default: a fresh temp dir = cache-cold)")
+    p.add_argument("--expect-warm", action="store_true",
+                   help="assert the first replica warms from the cache "
+                   "with ZERO compiles (the fresh-process restart gate)")
+    p.add_argument("--gold-frac", type=float, default=0.7,
+                   help="fraction of fleet-lane traffic in the gold class")
+    p.add_argument("--bronze-deadline-ms", type=float, default=None,
+                   help="bronze-class deadline (default 2x --deadline-ms)")
+    p.add_argument("--bronze-slo-ms", type=float, default=None,
+                   help="bronze-class p99 SLO (default 2x --slo-ms)")
     p.set_defaults(iters=1, warmup=1)
     args = p.parse_args()
     run_guarded(lambda: _body(args), args)
@@ -83,7 +108,8 @@ def _open_loop(server, nodes, rate):
     return done
 
 
-def _body(args):
+def _build_stack(args):
+    """The shared serving stack (graph, store, sampler, model, params)."""
     import numpy as np
 
     import jax
@@ -91,7 +117,6 @@ def _body(args):
     from quiver_tpu import Feature, GraphSageSampler
     from quiver_tpu.models.sage import GraphSAGE
     from quiver_tpu.parallel.train import empty_adjs, init_model
-    from quiver_tpu.serving import InferenceServer
 
     topo = build_graph(args)
     n = topo.node_count
@@ -109,6 +134,19 @@ def _body(args):
         model, jax.random.PRNGKey(args.seed),
         np.zeros((adjs[0].size[0], args.feature_dim), np.float32), adjs,
     )
+    return n, rng, feat, sampler, model, params
+
+
+def _body(args):
+    import numpy as np
+
+    import jax
+
+    from quiver_tpu.serving import InferenceServer
+
+    if args.fleet > 0:
+        return _fleet_body(args)
+    n, rng, feat, sampler, model, params = _build_stack(args)
 
     server = InferenceServer(
         sampler, model, params, feat, max_batch=args.max_batch,
@@ -178,6 +216,162 @@ def _body(args):
         requests=args.requests,
         **({"parity": parity} if parity else {}),
         **({"rate_qps": args.rate} if args.arrival == "open" else {}),
+    )
+
+
+def _fleet_open_loop(fleet, nodes, priorities, rate):
+    """Fixed-rate arrivals routed across the fleet on the real clock;
+    each replica's deadline coalescer decides its own flushes. Returns
+    the admitted request handles (shed ones included — the caller
+    attributes them per class)."""
+    from quiver_tpu.serving import ServeQueueFull
+
+    reqs = []
+    t0 = time.monotonic()
+    gap = 1.0 / rate
+    for i, (n, pri) in enumerate(zip(nodes, priorities)):
+        due = t0 + i * gap
+        while True:
+            now = time.monotonic()
+            if now >= due:
+                break
+            if any(s.batcher.ready() for s in fleet.servers):
+                fleet.pump()
+            else:
+                time.sleep(min(due - now, gap / 4))
+        try:
+            reqs.append(fleet.submit(int(n), priority=pri))
+        except ServeQueueFull:
+            pass  # hard rejection (counted in shed_by_class already)
+    while any(s.batcher.depth for s in fleet.servers):
+        fleet.pump(force=True)
+    return reqs
+
+
+def _fleet_body(args):
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from quiver_tpu.serving import PRIORITIES, ServingFleet
+
+    n, rng, feat, sampler, model, params = _build_stack(args)
+    cache_dir = args.aot_cache or tempfile.mkdtemp(prefix="quiver-aot-")
+    gold_dl = args.deadline_ms / 1e3
+    bronze_dl = (args.bronze_deadline_ms / 1e3 if args.bronze_deadline_ms
+                 else 2 * gold_dl)
+    slo = {"gold": args.slo_ms,
+           "bronze": args.bronze_slo_ms or 2 * args.slo_ms}
+
+    # -- cold start to first response (cache state decides cold vs warm) --
+    t0 = time.perf_counter()
+    fleet = ServingFleet(
+        sampler, model, params, feat, replicas=1, aot_cache=cache_dir,
+        seed=args.seed, max_batch=args.max_batch,
+        class_deadlines={"gold": gold_dl, "bronze": bronze_dl},
+    )
+    fleet.serve(rng.integers(0, n, 1))
+    first_response_s = time.perf_counter() - t0
+    first = fleet.cold_starts[0]
+    log(f"replica 0: first response {first_response_s:.2f}s "
+        f"(loaded {first['loaded']}, compiled {first['compiled']} from "
+        f"{cache_dir})")
+    if args.expect_warm and (first["compiled"] or fleet.recompiles):
+        raise AssertionError(
+            f"--expect-warm: replica 0 compiled {first['compiled']} "
+            f"programs (recompiles={fleet.recompiles}) instead of warming "
+            f"from {cache_dir}"
+        )
+
+    # -- scale-out: every further replica must join compile-free --------------
+    for _ in range(args.fleet - 1):
+        fleet.add_replica()
+    joins = fleet.cold_starts[1:]
+    for j in joins:
+        if j["compiled"]:
+            raise AssertionError(
+                f"replica join compiled {j['compiled']} programs against a "
+                f"populated cache: {joins}"
+            )
+    warm_join_s = (float(np.mean([j["seconds"] for j in joins]))
+                   if joins else None)
+    recompiles_warm = fleet.recompiles
+
+    # -- open-loop mixed-class traffic ---------------------------------------
+    nodes = rng.integers(0, n, args.requests)
+    priorities = np.where(rng.random(args.requests) < args.gold_frac,
+                          "gold", "bronze")
+    t0 = time.time()
+    reqs = _fleet_open_loop(fleet, nodes, priorities, args.rate)
+    wall = time.time() - t0
+
+    stats = fleet.stats()
+    recompiles_steady = fleet.recompiles - recompiles_warm
+    if recompiles_steady:
+        raise AssertionError(
+            f"steady-state recompiles: {recompiles_steady} (a warm fleet "
+            f"must only replay executables)"
+        )
+    served = [r for r in reqs if not r.shed]
+    per_class = {}
+    for cls in PRIORITIES:
+        lat = np.array([r.latency_s() * 1e3 for r in served
+                        if r.priority == cls])
+        if lat.size == 0:
+            per_class[cls] = {"p50": None, "p99": None}
+            continue
+        per_class[cls] = {
+            "p50": float(np.percentile(lat, 50)),
+            "p99": float(np.percentile(lat, 99)),
+        }
+        log(f"{cls}: {len(lat)} served, p99 {per_class[cls]['p99']:.2f}ms "
+            f"(SLO {slo[cls]}ms), shed {stats['shed'][cls]}, "
+            f"misses {stats['class_deadline_misses'][cls]}")
+
+    parity = None
+    if args.parity:
+        checked = 0
+        for r in served[:: max(1, len(served) // 16)]:
+            oracle = fleet.oracle(r.node, r.seq)
+            if not np.array_equal(r.result, oracle):
+                raise AssertionError(
+                    f"fleet parity violation: node {r.node} seq {r.seq}"
+                )
+            checked += 1
+        parity = f"ok:{checked}"
+        log(f"parity: {checked} fleet responses bitwise equal to the oracle")
+
+    qps = len(served) / wall
+    chips = jax.device_count()
+    p99g, p99b = per_class["gold"]["p99"], per_class["bronze"]["p99"]
+    emit(
+        "serve-fleet",
+        qps / chips,
+        "qps/chip",
+        None,
+        replicas=args.fleet,
+        rate_qps=args.rate,
+        gold_frac=args.gold_frac,
+        p99_gold_ms=round(p99g, 3) if p99g is not None else None,
+        p99_bronze_ms=round(p99b, 3) if p99b is not None else None,
+        gold_slo_ms=slo["gold"],
+        bronze_slo_ms=slo["bronze"],
+        p99_gold_within_slo=(None if p99g is None
+                             else bool(p99g <= slo["gold"])),
+        p99_bronze_within_slo=(None if p99b is None
+                               else bool(p99b <= slo["bronze"])),
+        shed_gold=stats["shed"]["gold"],
+        shed_bronze=stats["shed"]["bronze"],
+        cold_start_s=round(first_response_s, 3),
+        cold_start_compiled=first["compiled"],
+        cold_start_loaded=first["loaded"],
+        warm_join_s=round(warm_join_s, 3) if warm_join_s else None,
+        recompiles_steady=recompiles_steady,
+        aot_cache_entries=stats["aot_cache"]["entries"],
+        requests=args.requests,
+        **({"parity": parity} if parity else {}),
     )
 
 

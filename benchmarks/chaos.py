@@ -35,7 +35,12 @@ layer guarantees (quiver_tpu/resilience/):
   stage) leaves the old version readable with SAMPLING BIT-IDENTICAL to
   the pre-commit oracle and the failed commit quarantined not
   half-applied, and a successful commit bumps the version exactly once —
-  stale samplers raise until refreshed, then serve the mutated graph.
+  stale samplers raise until refreshed, then serve the mutated graph;
+* **scale-out**: the serving-fleet drill (quiver_tpu/serving/fleet.py) —
+  a replica joins MID-TRAFFIC, warms every ladder program from the
+  shared persisted AOT-executable cache with ZERO compiles, and serves
+  responses bitwise-identical to the already-running replica for the
+  same (node, seq) stream (and to the direct single-query oracle).
 
 Any drill failure raises (the session marks the job failed); success
 prints one ``CHAOS <drill> OK`` line per drill. ``--drills`` selects a
@@ -53,7 +58,7 @@ import numpy as np
 from benchmarks import common
 
 DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage",
-          "pipeline", "mutate")
+          "pipeline", "mutate", "scale-out")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -502,6 +507,68 @@ def drill_cold_outage(topo, feat, labels, local_batch, seed):
     )
 
 
+def drill_scale_out(topo, feat, seed):
+    """Serving-fleet scale-out: a replica joining mid-traffic warms from
+    the shared AOT cache (zero compiles) and answers the same
+    (node, seq) stream bitwise-identically to the running replica."""
+    import jax
+
+    from quiver_tpu import Feature, GraphSageSampler
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.train import empty_adjs, init_model
+    from quiver_tpu.serving import ServingFleet
+
+    rng = np.random.default_rng(seed)
+    n = topo.node_count
+    d = feat.shape[1]
+    store = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [4, 3], seed=3)
+    model = GraphSAGE(hidden=16, num_classes=4, num_layers=2)
+    adjs = empty_adjs([4, 3], batch=4, node_count=n)
+    params = init_model(
+        model, jax.random.PRNGKey(seed),
+        np.zeros((adjs[0].size[0], d), np.float32), adjs,
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet = ServingFleet(
+            sampler, model, params, store, replicas=1,
+            aot_cache=f"{tmp}/aot", seed=5, max_batch=2,
+        )
+        first = fleet.cold_starts[0]
+        assert first["compiled"] > 0 and first["loaded"] == 0, first
+        nodes = rng.integers(0, n, 12)
+        out0 = fleet.servers[0].serve(nodes)  # traffic before the join
+
+        joiner = fleet.add_replica()  # joins mid-traffic
+        join = fleet.cold_starts[-1]
+        assert join["compiled"] == 0, f"join compiled programs: {join}"
+        assert join["loaded"] == first["compiled"], (join, first)
+        assert joiner.recompiles == 0, joiner.recompiles
+
+        # replay the same node stream on the joiner: its batcher starts
+        # at seq 0 exactly like replica 0 did, so the (node, seq) pairs
+        # match and (shared base seed) responses must be bitwise equal
+        out1 = joiner.serve(nodes)
+        for a, b in zip(out0, out1):
+            assert (a.node, a.seq) == (b.node, b.seq), (a, b)
+            assert np.array_equal(a.result, b.result), \
+                f"replica divergence at (node={a.node}, seq={a.seq})"
+            assert np.array_equal(b.result, fleet.oracle(b.node, b.seq)), \
+                f"oracle divergence at (node={b.node}, seq={b.seq})"
+
+        # the grown fleet keeps serving mixed-class traffic compile-free
+        fleet.serve(rng.integers(0, n, 8), priority="bronze")
+        assert fleet.recompiles == first["compiled"], \
+            (fleet.recompiles, first)
+    common.log(
+        f"CHAOS scale-out OK (mid-traffic join warmed {join['loaded']} "
+        f"programs from the shared AOT cache with 0 compiles; "
+        f"{len(nodes)} (node, seq) responses bitwise-identical across "
+        f"replicas and vs the oracle)"
+    )
+
+
 def drill_mutate(topo_seed_graph, feat, local_batch, seed):
     """Malformed-delta quarantine; mid-commit crash at every pre-publish
     stage leaves the old version readable and sampling bit-identical;
@@ -651,6 +718,8 @@ def main():
             drill_pipeline(topo, feat, labels, args.local_batch, args.seed)
         if "mutate" in selected:
             drill_mutate(topo, feat, args.local_batch, args.seed)
+        if "scale-out" in selected:
+            drill_scale_out(topo, feat, args.seed)
         common.log(f"CHAOS all drills passed ({', '.join(selected)})")
         return 0
 

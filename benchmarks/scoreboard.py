@@ -110,6 +110,14 @@ JOBS = [
      "warmup), p50/p95/p99 vs SLO + bitwise ladder==oracle parity; the "
      "reference's closest analogue is its IPC-shared Feature — it never "
      "shipped an end-to-end serving path"),
+    ("serve-fleet", "benchmarks.bench_serve",
+     ["--fleet", "2", "--parity"],
+     "serving fleet scale-out over one persisted AOT-executable cache: "
+     "replica joins deserialize instead of compiling (cold-start vs "
+     "warm-join in the extras, steady recompiles asserted 0), gold/"
+     "bronze SLO classes with per-class p99 and shed-before-gold "
+     "admission; the reference's many-frontends-one-IPC-Feature pattern "
+     "taken to whole-program replay"),
     ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"],
      "no reference baseline (SAINT never landed there)"),
     ("validation", "benchmarks.tpu_validation", [],
@@ -370,7 +378,9 @@ def write_outputs(results, out, smoke, merge=False):
                                "topo_shrink", "comm_reduction",
                                "overlap_efficiency", "scan_speedup",
                                "recompiles_steady", "pipeline_depth",
-                               "prefetch")}
+                               "prefetch", "replicas", "p99_gold_ms",
+                               "p99_bronze_ms", "shed_gold", "shed_bronze",
+                               "cold_start_s", "warm_join_s")}
             if extras:
                 metric += " " + ",".join(f"{k}={v}" for k, v in extras.items())
             lines.append(

@@ -48,10 +48,12 @@ from .resilience import (
 from .sampling.dist import DistGraphSageSampler
 from .sampling.sampler import Adj, GraphSageSampler, SampleOutput
 from .serving import (
+    AOTExecutableCache,
     DeadlineBatcher,
     EmbeddingRefresher,
     InferenceServer,
     ServeQueueFull,
+    ServingFleet,
 )
 from .streaming import (
     CommitAborted,
@@ -133,6 +135,8 @@ __all__ = [
     "DeadlineBatcher",
     "EmbeddingRefresher",
     "ServeQueueFull",
+    "ServingFleet",
+    "AOTExecutableCache",
     "AlphaTuner",
     "CacheController",
     "CostModel",

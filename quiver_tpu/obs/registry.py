@@ -61,6 +61,9 @@ __all__ = [
     "SERVE_DEADLINE_MISSES",
     "SERVE_DEGRADED_LOOKUPS",
     "SERVE_RECOMPILES",
+    "SERVE_AOT_LOADS",
+    "SERVE_SHED",
+    "SERVE_CLASS_MISSES",
     "TRAIN_OVERLAP_EFFICIENCY",
     "PIPELINE_REISSUES",
     "FEATURE_ROW_HEAT",
@@ -108,6 +111,16 @@ SERVE_REQUESTS = "serve.requests"
 SERVE_DEADLINE_MISSES = "serve.deadline_misses"
 SERVE_DEGRADED_LOOKUPS = "serve.degraded_lookups"
 SERVE_RECOMPILES = "serve.recompiles"
+# fleet scale-out (serving/aot.py + serving/fleet.py): ladder programs
+# warmed by deserializing a persisted AOT executable instead of compiling
+# (a cache-warm replica reports aot_loads == program count and
+# recompiles == 0), plus the SLO-class-attributed admission outcomes —
+# requests shed under a full queue and requests completed after their
+# deadline, both as vectors in serving.coalesce.PRIORITIES order
+# (gold, bronze)
+SERVE_AOT_LOADS = "serve.aot_loads"
+SERVE_SHED = "serve.shed_requests"
+SERVE_CLASS_MISSES = "serve.class_deadline_misses"
 # software-pipelined epoch (parallel/trainer.py pipeline_depth=1): the
 # derived overlap-efficiency gauge (serial stage-sum over measured
 # pipelined step time, > 1.0 = the schedule is hiding sample/gather

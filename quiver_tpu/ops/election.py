@@ -17,7 +17,12 @@ megakernel) follow one contract, factored here:
    file shared by every election (``QUIVER_ELECTION_CACHE``, default
    ``~/.cache/quiver_tpu/kernel_elections.json``), keyed by election name
    and invalidated by (rev, jax version, device kind) so a kernel or
-   toolchain change forces re-election instead of trusting stale numbers;
+   toolchain change forces re-election instead of trusting stale numbers.
+   The file is an optimization, never a failure source: a corrupt or
+   truncated cache degrades to re-election with ONE warning (fail-safe,
+   see :func:`tolerant_cache_read`) and every rewrite is an atomic
+   publish (:func:`atomic_publish_bytes`) — both shared with the serving
+   AOT executable cache (serving/aot.py);
 5. ``env_var=pallas|xla`` (e.g. ``QUIVER_GATHER_KERNEL``,
    ``QUIVER_SAMPLE_KERNEL``) overrides the measurement.
 
@@ -37,9 +42,14 @@ from collections.abc import Callable
 
 import jax
 
-from ..utils.trace import get_logger
+from ..utils.trace import get_logger, warn_once
 
-__all__ = ["KernelElection", "validate_kernel_arg"]
+__all__ = [
+    "KernelElection",
+    "atomic_publish_bytes",
+    "tolerant_cache_read",
+    "validate_kernel_arg",
+]
 
 
 def validate_kernel_arg(kernel: str) -> str:
@@ -66,6 +76,67 @@ def _election_cache_path() -> str:
             os.path.expanduser("~/.cache/quiver_tpu/kernel_elections.json"),
         )
     return _ELECTION_CACHE_PATH
+
+
+# -- shared disk-cache discipline (elections AND the serving AOT cache) -----
+#
+# Both persisted caches are pure *optimizations*: a hit skips a
+# re-measurement (election) or a recompilation (serving/aot.py). They must
+# therefore be fail-safe in both directions — a corrupt/truncated/
+# unreadable file degrades to a miss with ONE process-wide warning (never
+# a raise on the serve/train path), and a publish is atomic (readers of
+# the shared file never observe a half-written blob, even with several
+# replicas warming concurrently).
+
+def tolerant_cache_read(path: str, reader, *, what: str,
+                        child: str | None = None):
+    """Fail-safe shared-cache read: ``reader(binary_file)`` or ``None``.
+
+    A missing file is a silent miss; anything else (truncation, garbage
+    bytes, a permission error, a reader that chokes) is a miss plus ONE
+    warning per (process, path) — the caller recomputes and republishes
+    over the bad file, so the warning self-heals.
+    """
+    try:
+        with open(path, "rb") as f:
+            return reader(f)
+    except FileNotFoundError:
+        return None
+    except Exception as e:  # noqa: BLE001 — any corruption degrades to a
+        # recompute; a cache must never be the thing that takes serving down
+        warn_once(
+            f"cache-unreadable:{path}",
+            "%s cache %s unreadable (%s: %s); ignoring it — recomputing "
+            "and republishing over it", what, path, type(e).__name__,
+            str(e)[:200], child=child,
+        )
+        return None
+
+
+def atomic_publish_bytes(path: str, data: bytes) -> None:
+    """Atomically publish ``data`` at ``path`` (write temp + fsync +
+    ``os.replace``): concurrent readers — other serving replicas warming
+    from the same cache — see either the old blob or the new one, never a
+    torn write. Raises ``OSError`` on failure; callers that treat the
+    cache as optional catch it."""
+    import os
+
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp_path = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp_path, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, path)
+    except OSError:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        raise
 
 
 class KernelElection:
@@ -110,15 +181,30 @@ class KernelElection:
         return (f"rev{self.rev}-jax{jax.__version__}-"
                 + str(jax.devices()[0].device_kind))
 
-    def _load_cached(self, cache_key: str) -> dict | None:
+    def _load_blob(self) -> dict:
+        """The whole shared cache file as a dict — ``{}`` on miss, and
+        ``{}`` with ONE warning on a corrupt/truncated file (fail-safe to
+        re-election, never a raise; tests/test_kernel_election.py pins
+        it). A non-dict JSON document counts as corrupt too."""
         import json
 
-        try:
-            with open(_election_cache_path()) as f:
-                blob = json.load(f)
-        except (OSError, ValueError):
-            return None
-        entry = blob.get(self.name) if isinstance(blob, dict) else None
+        blob = tolerant_cache_read(
+            _election_cache_path(), json.load,
+            what="kernel-election", child=self._log_child,
+        )
+        if blob is not None and not isinstance(blob, dict):
+            warn_once(
+                f"cache-unreadable:{_election_cache_path()}:shape",
+                "kernel-election cache %s holds a %s, not an object; "
+                "ignoring it — re-electing and republishing over it",
+                _election_cache_path(), type(blob).__name__,
+                child=self._log_child,
+            )
+            return {}
+        return blob or {}
+
+    def _load_cached(self, cache_key: str) -> dict | None:
+        entry = self._load_blob().get(self.name)
         if (isinstance(entry, dict) and entry.get("key") == cache_key
                 and entry.get("kernel") in ("pallas", "xla")):
             return entry
@@ -126,26 +212,16 @@ class KernelElection:
 
     def _store(self, entry: dict) -> None:
         import json
-        import os
 
         path = _election_cache_path()
+        # drop anything that is not a nested election entry (e.g. a
+        # pre-generalization flat gather_election.json pointed at by
+        # QUIVER_ELECTION_CACHE)
+        blob = {k: v for k, v in self._load_blob().items()
+                if isinstance(v, dict) and "kernel" in v}
+        blob[self.name] = entry
         try:
-            try:
-                with open(path) as f:
-                    blob = json.load(f)
-            except (OSError, ValueError):
-                blob = {}
-            if not isinstance(blob, dict):
-                blob = {}
-            # drop anything that is not a nested election entry (e.g. a
-            # pre-generalization flat gather_election.json pointed at by
-            # QUIVER_ELECTION_CACHE)
-            blob = {k: v for k, v in blob.items()
-                    if isinstance(v, dict) and "kernel" in v}
-            blob[self.name] = entry
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "w") as f:
-                json.dump(blob, f)
+            atomic_publish_bytes(path, json.dumps(blob).encode("utf-8"))
         except OSError:
             pass
 

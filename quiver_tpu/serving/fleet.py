@@ -1,0 +1,209 @@
+"""Serving fleet scale-out: N replicas, one resident state, zero-compile joins.
+
+The reference scales serving by *sharing*, not copying: one IPC-resident
+``Feature`` (shared CUDA tensors) behind many frontend processes, so a
+new worker attaches to existing state instead of rebuilding it. The TPU
+analogue here shares along both axes that matter:
+
+* **data** — every :class:`~quiver_tpu.serving.server.InferenceServer`
+  replica serves the SAME sampler topology, feature store (plain,
+  sharded, or breaker-wrapped) and :class:`~quiver_tpu.control
+  .CacheController` sketch, so fleet-wide serve traffic feeds one
+  re-tiering decision stream;
+* **programs** — every replica warms from the SAME
+  :class:`~quiver_tpu.serving.aot.AOTExecutableCache`: the first replica
+  compiles each ladder program once and publishes the serialized backend
+  executable; each subsequent replica (including one in a *fresh
+  process*) deserializes and replays it, joining the fleet with ZERO
+  compiles and bitwise-identical responses for the same ``(node, seq)``
+  stream (all replicas fold the same base seed).
+
+Routing is least-queue-depth with full-queue failover, and admission
+control is SLO-class aware per replica (gold/bronze per-class deadlines;
+the shed policy under :class:`~quiver_tpu.serving.coalesce
+.ServeQueueFull` drops bronze before gold — see ``serving/coalesce.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .aot import AOTExecutableCache
+from .coalesce import PRIORITIES, ServeQueueFull, ServeRequest
+from .server import InferenceServer
+
+__all__ = ["ServingFleet"]
+
+
+class ServingFleet:
+    """N :class:`InferenceServer` replicas over one shared resident state.
+
+    Args:
+      sampler / model / params / feature: the shared serving state (see
+        :class:`InferenceServer`); every replica serves the same store
+        and topology.
+      replicas: initial fleet size (``add_replica`` grows it later —
+        e.g. mid-traffic, the chaos ``scale-out`` drill).
+      aot_cache: the shared persisted-executable cache every replica
+        warms from and publishes to — an :class:`AOTExecutableCache`, a
+        directory path, or ``True`` (default) for the default location.
+        ``None`` disables persistence (every replica compiles).
+      controller: optional shared :class:`~quiver_tpu.control
+        .CacheController`; all replicas feed one sketch.
+      seed: base PRNG seed shared by ALL replicas, so a response is a
+        function of ``(node, seq)`` alone — any replica answers any
+        request identically, which is what makes least-depth routing
+        transparent and the scale-out parity drill bitwise.
+      warm: warm each constructed replica from the cache immediately
+        (cold-start timings land in :attr:`cold_starts`).
+      clock: injectable clock handed to every replica's batcher.
+      **server_kwargs: forwarded to every :class:`InferenceServer`
+        (``max_batch``, ``buckets``, ``class_deadlines``, ``max_queue``,
+        ``degraded``, ...).
+    """
+
+    def __init__(self, sampler, model, params, feature, *,
+                 replicas: int = 1, aot_cache=True, controller=None,
+                 seed: int = 0, warm: bool = True, clock=time.monotonic,
+                 **server_kwargs):
+        if aot_cache is not None and not isinstance(aot_cache,
+                                                    AOTExecutableCache):
+            aot_cache = AOTExecutableCache(
+                None if aot_cache is True else aot_cache
+            )
+        self.sampler = sampler
+        self.model = model
+        self.params = params
+        self.feature = feature
+        self.aot_cache = aot_cache
+        self.controller = controller
+        self.seed = int(seed)
+        self.clock = clock
+        self._server_kwargs = dict(server_kwargs)
+        self.servers: list[InferenceServer] = []
+        #: per-replica join records: ``{"seconds", "loaded", "compiled"}``
+        #: — the cold-start-to-ready ledger the fleet benchmark reports
+        #: (cache-cold joins show ``compiled > 0``, cache-warm joins
+        #: ``compiled == 0``).
+        self.cold_starts: list[dict] = []
+        for _ in range(int(replicas)):
+            self.add_replica(warm=warm)
+
+    # -- membership ----------------------------------------------------------
+
+    def add_replica(self, warm: bool = True) -> InferenceServer:
+        """Construct one replica against the shared state and (by
+        default) warm it from the shared AOT cache. Against a populated
+        cache the join performs zero compiles — the scale-out latency is
+        deserialization, not compilation."""
+        t0 = time.perf_counter()
+        srv = InferenceServer(
+            self.sampler, self.model, self.params, self.feature,
+            aot_cache=self.aot_cache, controller=self.controller,
+            seed=self.seed, clock=self.clock, **self._server_kwargs,
+        )
+        ws = {"loaded": 0, "compiled": 0}
+        if warm:
+            ws = srv.warm_from_cache() if self.aot_cache is not None \
+                else {"loaded": 0, "compiled": srv.warmup()}
+        self.cold_starts.append(
+            {"seconds": time.perf_counter() - t0, **ws}
+        )
+        self.servers.append(srv)
+        return srv
+
+    # -- routing + serving ---------------------------------------------------
+
+    def submit(self, node: int, deadline_s: float | None = None,
+               priority: str = "gold") -> ServeRequest:
+        """Admit one point query on the least-loaded replica; a replica
+        at its bound runs its own shed policy (bronze before gold), and a
+        hard rejection fails over to the next replica before propagating
+        :class:`ServeQueueFull` — fleet-level admission control."""
+        if not self.servers:
+            raise RuntimeError("fleet has no replicas; call add_replica()")
+        last_err = None
+        for srv in sorted(self.servers, key=lambda s: s.batcher.depth):
+            try:
+                return srv.submit(node, deadline_s, priority)
+            except ServeQueueFull as e:
+                last_err = e
+        raise last_err
+
+    def pump(self, force: bool = False) -> list[ServeRequest]:
+        """Serve at most one due batch per replica; returns the completed
+        requests across the fleet."""
+        done: list[ServeRequest] = []
+        for srv in self.servers:
+            done.extend(srv.pump(force=force))
+        return done
+
+    def serve(self, nodes, deadline_s: float | None = None,
+              priority: str = "gold") -> list[ServeRequest]:
+        """Closed-loop convenience: admit ``nodes`` across the fleet and
+        drain every queue; returns the requests in admission order."""
+        reqs = [self.submit(int(n), deadline_s, priority)
+                for n in np.asarray(nodes)]
+        while any(not r.done for r in reqs):
+            self.pump(force=True)
+        return reqs
+
+    # -- streaming-mutation versioning --------------------------------------
+
+    def check_version(self) -> None:
+        for srv in self.servers:
+            srv.check_version()
+
+    def refresh(self, warmup: bool = True) -> "ServingFleet":
+        """Re-place and rebuild every replica after a streaming commit.
+        The first replica's rebuild compiles the new CSR version's
+        programs and publishes them; every later replica's rebuild hits
+        the cache — a fleet pays each post-commit compile once, not once
+        per replica."""
+        for srv in self.servers:
+            srv.refresh(warmup=warmup)
+        return self
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def recompiles(self) -> int:
+        """Fleet-total ladder compilations."""
+        return sum(s.recompiles for s in self.servers)
+
+    @property
+    def aot_loads(self) -> int:
+        """Fleet-total programs warmed from the persisted cache."""
+        return sum(s.aot_loads for s in self.servers)
+
+    def oracle(self, node: int, seq: int) -> np.ndarray:
+        """The fleet-wide parity reference: replicas share the base seed,
+        so replica 0's direct (ladder-free) answer is THE answer every
+        replica must reproduce bitwise for ``(node, seq)``."""
+        return self.servers[0].oracle(node, seq)
+
+    def stats(self) -> dict:
+        """Fleet-aggregated serve counters (per-class shed/miss summed
+        across replicas) plus the per-replica breakdown."""
+        per = [s.stats() for s in self.servers]
+        return {
+            "replicas": len(per),
+            "requests": sum(p["requests"] for p in per),
+            "deadline_misses": sum(p["deadline_misses"] for p in per),
+            "class_deadline_misses": {
+                c: sum(p["class_deadline_misses"][c] for p in per)
+                for c in PRIORITIES
+            },
+            "shed": {
+                c: sum(p["shed"][c] for p in per) for c in PRIORITIES
+            },
+            "recompiles": sum(p["recompiles"] for p in per),
+            "aot_loads": sum(p["aot_loads"] for p in per),
+            "queue_depth": sum(p["queue_depth"] for p in per),
+            "cold_starts": list(self.cold_starts),
+            "aot_cache": (self.aot_cache.stats()
+                          if self.aot_cache is not None else None),
+            "per_replica": per,
+        }

@@ -58,10 +58,20 @@ class ServeLadder:
         sampler's worst-case single-seed plan (tight for modest fanouts).
       on_compile: callback invoked once per program build — the server
         feeds ``serve.recompiles`` from it.
+      aot_cache: optional :class:`~quiver_tpu.serving.aot
+        .AOTExecutableCache`. When set, every program build consults the
+        cache first (a hit deserializes the backend executable — ZERO
+        compiles, ``on_compile`` not invoked) and every compile publishes
+        its executable for the next replica. Keyed by
+        :meth:`fingerprint`; any mismatch (new CSR commit, different
+        toolchain, different geometry) falls back to compile-and-publish.
+      on_cache_load: callback invoked once per cache-served program — the
+        server feeds ``serve.aot_loads`` from it.
     """
 
     def __init__(self, sampler: GraphSageSampler, model, feature_dim: int,
-                 row_dtype=jnp.float32, lane_caps=None, on_compile=None):
+                 row_dtype=jnp.float32, lane_caps=None, on_compile=None,
+                 aot_cache=None, on_cache_load=None):
         if getattr(sampler, "topo_sharding", "replicated") != "replicated":
             raise NotImplementedError(
                 "ServeLadder requires a replicated-topology sampler; the "
@@ -93,7 +103,10 @@ class ServeLadder:
             (self.lane_caps[l], widths[l], self.sizes[l])
             for l in range(len(self.sizes))
         )
+        self.aot_cache = aot_cache
+        self._on_cache_load = on_cache_load
         self.compiles = 0
+        self.cache_loads = 0
         self._sample_exec: dict[int, object] = {}
         self._forward_exec: dict[int, object] = {}
         self._params_struct = None
@@ -173,15 +186,88 @@ class ServeLadder:
             raise RuntimeError("call bind_params() before compiling forward")
         return jax.jit(run).trace(x, eis, params)
 
-    def _build_sample(self, bucket: int):
-        compiled = self.trace_sample(bucket).lower().compile()
+    # -- persisted-executable fingerprint ------------------------------------
+
+    @staticmethod
+    def _avals(tree) -> list:
+        out = []
+        for x in jax.tree_util.tree_leaves(tree):
+            # leaves are arrays OR ShapeDtypeStructs (the bound params
+            # struct) — both carry .shape/.dtype
+            a = x if hasattr(x, "dtype") else jnp.asarray(x)
+            out.append([list(map(int, a.shape)), str(a.dtype)])
+        return out
+
+    def fingerprint_components(self, kind: str, bucket: int) -> dict:
+        """Everything the ``(kind, bucket)`` program's compiled artifact
+        closed over, as a JSON-able dict (see :func:`~quiver_tpu.serving
+        .aot.program_fingerprint`). The CSR committed ``version`` AND the
+        topology leaf avals are both in the key: a streaming commit
+        always forks the fingerprint (refresh re-checks the cache instead
+        of trusting a pre-commit executable), and shape-changing commits
+        are caught even if versions were ever reused."""
+        s = self.sampler
+        dev = jax.devices()[0]
+        comp = {
+            "target": f"serve.{kind}",  # graftaudit-style target id
+            "bucket": int(bucket),
+            "sizes": list(self.sizes),
+            "lane_caps": list(self.lane_caps),
+            "kernel": s.kernel,
+            "dedup": bool(s.dedup),
+            "weighted": bool(s.weighted),
+            "csr_version": int(getattr(s.csr_topo, "version", 0)),
+            "topo_avals": self._avals(s.topo),
+            "key_aval": self._avals(s._key),
+            "jax": jax.__version__,
+            "platform": dev.platform,
+            "device_kind": str(dev.device_kind),
+            "n_devices": int(jax.device_count()),
+        }
+        if kind == "forward":
+            if self._params_struct is None:
+                raise RuntimeError(
+                    "call bind_params() before fingerprinting forward"
+                )
+            comp["model"] = f"{type(self.model).__name__}:{self.model!r}"
+            comp["params_treedef"] = str(
+                jax.tree_util.tree_structure(self._params_struct)
+            )
+            comp["params_avals"] = self._avals(self._params_struct)
+            comp["feature_dim"] = self.feature_dim
+            comp["row_dtype"] = str(self.row_dtype)
+        return comp
+
+    def fingerprint(self, kind: str, bucket: int) -> str:
+        from .aot import program_fingerprint
+
+        return program_fingerprint(self.fingerprint_components(kind, bucket))
+
+    # -- program builds (cache-first when an AOT cache is attached) ----------
+
+    def _build(self, kind: str, bucket: int, trace_fn):
+        fp = None
+        if self.aot_cache is not None:
+            fp = self.fingerprint(kind, bucket)
+            ex = self.aot_cache.load(fp)
+            if ex is not None:
+                self.cache_loads += 1
+                if self._on_cache_load is not None:
+                    self._on_cache_load()
+                return ex
+        compiled = trace_fn(bucket).lower().compile()
         self._note_compile()
+        if self.aot_cache is not None:
+            self.aot_cache.store(
+                fp, compiled, self.fingerprint_components(kind, bucket)
+            )
         return compiled
 
+    def _build_sample(self, bucket: int):
+        return self._build("sample", bucket, self.trace_sample)
+
     def _build_forward(self, bucket: int):
-        compiled = self.trace_forward(bucket).lower().compile()
-        self._note_compile()
-        return compiled
+        return self._build("forward", bucket, self.trace_forward)
 
     def _note_compile(self):
         self.compiles += 1
@@ -219,6 +305,22 @@ class ServeLadder:
             self.sample_exec(int(b))
             self.forward_exec(int(b))
         return self.compiles - before
+
+    def warm_from_cache(self, buckets) -> dict:
+        """Warm every bucket's program pair, deserializing from the
+        attached :class:`~quiver_tpu.serving.aot.AOTExecutableCache`
+        wherever the fingerprint matches and compiling (then publishing)
+        only the rest. Returns ``{"loaded": n, "compiled": m}`` — a
+        replica warming from a populated cache reports ``compiled == 0``
+        and its replayed executables answer bitwise-identically to a
+        compile-from-scratch replica (same program, same backend
+        artifact)."""
+        before_c, before_l = self.compiles, self.cache_loads
+        for b in buckets:
+            self.sample_exec(int(b))
+            self.forward_exec(int(b))
+        return {"loaded": self.cache_loads - before_l,
+                "compiled": self.compiles - before_c}
 
     # -- parity oracle -------------------------------------------------------
 

@@ -37,15 +37,19 @@ import jax.numpy as jnp
 
 from ..core.topology import VersionMismatchError
 from ..obs.registry import (
+    SERVE_AOT_LOADS,
+    SERVE_CLASS_MISSES,
     SERVE_DEADLINE_MISSES,
     SERVE_DEGRADED_LOOKUPS,
     SERVE_RECOMPILES,
     SERVE_REQUESTS,
+    SERVE_SHED,
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
 from ..resilience.elastic import DegradedFeature
-from .coalesce import DeadlineBatcher, ServeRequest, ladder_buckets
+from .aot import AOTExecutableCache
+from .coalesce import PRIORITIES, DeadlineBatcher, ServeRequest, ladder_buckets
 from .ladder import ServeLadder
 
 __all__ = ["InferenceServer"]
@@ -83,6 +87,15 @@ class InferenceServer:
         (``controller.end_epoch(store)`` between serving windows, then
         :meth:`refresh` if a repin bumped the version). Attached to the
         underlying store when it is a ``ShardedFeature``.
+      class_deadlines: optional per-SLO-class default deadlines for the
+        batcher, e.g. ``{"gold": 0.02, "bronze": 0.1}``; the shed policy
+        under a full queue drops bronze before gold.
+      aot_cache: optional persisted-executable cache — an
+        :class:`~quiver_tpu.serving.aot.AOTExecutableCache`, a directory
+        path, or ``True`` for the default location. When set, ladder
+        program builds consult the cache before compiling and publish
+        after compiling; :meth:`warm_from_cache` is the compile-free
+        replica cold-start path.
     """
 
     STAGES = ("queue_wait", "pad", "sample", "gather", "forward", "readback")
@@ -96,7 +109,8 @@ class InferenceServer:
                  probe_every: int = 8,
                  metrics: MetricsRegistry | None = None,
                  timeline: StepTimeline | None = None,
-                 controller=None):
+                 controller=None, class_deadlines: dict | None = None,
+                 aot_cache=None):
         self.sampler = sampler
         self.model = model
         self.params = params
@@ -118,11 +132,18 @@ class InferenceServer:
                 else feature
             if hasattr(store, "_controller"):
                 controller.attach(store)
+        if aot_cache is not None and not isinstance(aot_cache,
+                                                    AOTExecutableCache):
+            aot_cache = AOTExecutableCache(
+                None if aot_cache is True else aot_cache
+            )
+        self.aot_cache = aot_cache
         self.batcher = DeadlineBatcher(
             buckets=tuple(buckets) if buckets else ladder_buckets(max_batch),
             default_deadline_s=default_deadline_s,
             budget_fraction=budget_fraction,
             max_queue=max_queue, clock=clock,
+            class_deadlines=class_deadlines,
         )
         self._base_key = jax.random.PRNGKey(seed)
         self._lane_caps = lane_caps
@@ -144,9 +165,27 @@ class InferenceServer:
             doc="ladder program compilations (0 after warmup = the "
                 "steady-state never-recompile contract)",
         )
+        self.metrics.counter(
+            SERVE_AOT_LOADS, unit="programs",
+            doc="ladder programs warmed by deserializing a persisted AOT "
+                "executable instead of compiling (a cache-warm replica "
+                "reports recompiles == 0)",
+        )
+        self.metrics.counter(
+            SERVE_SHED, shape=(len(PRIORITIES),), unit="requests",
+            doc="requests shed at admission under a full queue, by SLO "
+                "class (coalesce.PRIORITIES order: gold, bronze)",
+        )
+        self.metrics.counter(
+            SERVE_CLASS_MISSES, shape=(len(PRIORITIES),), unit="requests",
+            doc="deadline misses attributed by SLO class "
+                "(coalesce.PRIORITIES order: gold, bronze)",
+        )
         self._requests_total = 0
         self._misses_total = 0
         self._recompiles_total = 0
+        self._aot_loads_total = 0
+        self._class_misses = [0] * len(PRIORITIES)
         self._serve_degraded_total = 0
         self._degraded_seen = (
             feature.degraded_total if isinstance(feature, DegradedFeature)
@@ -166,6 +205,8 @@ class InferenceServer:
             self.sampler, self.model, self._feature_dim,
             row_dtype=self._row_dtype, lane_caps=self._lane_caps,
             on_compile=self._on_ladder_compile,
+            aot_cache=self.aot_cache,
+            on_cache_load=self._on_ladder_cache_load,
         )
         ladder.bind_params(self.params)
         return ladder
@@ -173,6 +214,14 @@ class InferenceServer:
     def _on_ladder_compile(self) -> None:
         self._recompiles_total += 1
         self.metrics.set(SERVE_RECOMPILES, np.int32(self._recompiles_total))
+
+    def _on_ladder_cache_load(self) -> None:
+        self._aot_loads_total += 1
+        self.metrics.set(SERVE_AOT_LOADS, np.int32(self._aot_loads_total))
+
+    def _sync_shed(self) -> None:
+        shed = [self.batcher.shed_by_class[p] for p in PRIORITIES]
+        self.metrics.set(SERVE_SHED, np.asarray(shed, np.int32))
 
     # -- streaming-mutation versioning --------------------------------------
 
@@ -191,9 +240,14 @@ class InferenceServer:
 
     def refresh(self, warmup: bool = True) -> "InferenceServer":
         """Re-place the device topology and rebuild the compiled ladder
-        after a streaming commit. ``warmup`` recompiles the buckets that
-        were live before (counted in ``serve.recompiles`` — a mutation
-        epoch pays its compiles at the boundary, not per request)."""
+        after a streaming commit. ``warmup`` rebuilds the buckets that
+        were live before — with an attached AOT cache each rebuild
+        RE-CHECKS the cache first (the committed CSR version and topology
+        avals are in the fingerprint, so a replica that already compiled
+        and published this version's programs hands them over; only a
+        genuinely new program compiles, counted in ``serve.recompiles``
+        — a mutation epoch pays its compiles at the boundary, not per
+        request)."""
         live = sorted(
             set(self._ladder._sample_exec) | set(self._ladder._forward_exec)
         )
@@ -206,9 +260,15 @@ class InferenceServer:
 
     # -- serving -------------------------------------------------------------
 
-    def submit(self, node: int, deadline_s: float | None = None) -> ServeRequest:
-        """Admit one point query (see :meth:`DeadlineBatcher.submit`)."""
-        return self.batcher.submit(node, deadline_s)
+    def submit(self, node: int, deadline_s: float | None = None,
+               priority: str = "gold") -> ServeRequest:
+        """Admit one point query (see :meth:`DeadlineBatcher.submit`);
+        the shed policy under a full queue drops bronze before gold, and
+        shed counts land per class on ``serve.shed_requests``."""
+        try:
+            return self.batcher.submit(node, deadline_s, priority)
+        finally:
+            self._sync_shed()
 
     def warmup(self, buckets=None) -> int:
         """Pre-compile the ladder (all batcher buckets by default);
@@ -216,6 +276,19 @@ class InferenceServer:
         after warmup replays executables only."""
         self.check_version()
         return self._ladder.warmup(
+            tuple(buckets) if buckets else self.batcher.buckets
+        )
+
+    def warm_from_cache(self, buckets=None) -> dict:
+        """Compile-free cold start: warm the ladder (all batcher buckets
+        by default) by deserializing persisted AOT executables wherever
+        the fingerprint matches, compiling-and-publishing only the rest.
+        Returns ``{"loaded": n, "compiled": m}`` — against a populated
+        cache a new replica reports ``compiled == 0`` (``recompiles``
+        stays 0) and serves responses bitwise-identical to the replica
+        that compiled, for every bucket and padded tail."""
+        self.check_version()
+        return self._ladder.warm_from_cache(
             tuple(buckets) if buckets else self.batcher.buckets
         )
 
@@ -233,10 +306,12 @@ class InferenceServer:
             self.timeline.observe("queue_wait", now - r.t_admit)
         return self._run_batch(reqs, bucket)
 
-    def serve(self, nodes, deadline_s: float | None = None) -> list[ServeRequest]:
+    def serve(self, nodes, deadline_s: float | None = None,
+              priority: str = "gold") -> list[ServeRequest]:
         """Closed-loop convenience: admit ``nodes`` and drain the queue;
         returns their completed requests in admission order."""
-        reqs = [self.submit(int(n), deadline_s) for n in np.asarray(nodes)]
+        reqs = [self.submit(int(n), deadline_s, priority)
+                for n in np.asarray(nodes)]
         while any(not r.done for r in reqs):
             self.pump(force=True)
         return reqs
@@ -295,10 +370,15 @@ class InferenceServer:
             r.t_done = t_done
             r.missed = t_done > r.deadline_at
             misses += int(r.missed)
+            if r.missed:
+                self._class_misses[PRIORITIES.index(r.priority)] += 1
         self._requests_total += len(reqs)
         self._misses_total += misses
         self.metrics.set(SERVE_REQUESTS, np.int32(self._requests_total))
         self.metrics.set(SERVE_DEADLINE_MISSES, np.int32(self._misses_total))
+        self.metrics.set(
+            SERVE_CLASS_MISSES, np.asarray(self._class_misses, np.int32)
+        )
         if isinstance(self.feature, DegradedFeature):
             delta = self.feature.degraded_total - self._degraded_seen
             if delta:
@@ -337,6 +417,12 @@ class InferenceServer:
         counter; flat after :meth:`warmup` = steady-state contract)."""
         return self._recompiles_total
 
+    @property
+    def aot_loads(self) -> int:
+        """Ladder programs warmed from the persisted AOT cache (the
+        ``serve.aot_loads`` counter)."""
+        return self._aot_loads_total
+
     def stats(self) -> dict:
         """Host-side serve counters + per-stage latency quantiles."""
         stages = {
@@ -346,8 +432,13 @@ class InferenceServer:
         return {
             "requests": self._requests_total,
             "deadline_misses": self._misses_total,
+            "class_deadline_misses": dict(
+                zip(PRIORITIES, self._class_misses)
+            ),
+            "shed": dict(self.batcher.shed_by_class),
             "degraded_lookups": self._serve_degraded_total,
             "recompiles": self._recompiles_total,
+            "aot_loads": self._aot_loads_total,
             "queue_depth": self.batcher.depth,
             "stages": stages,
         }

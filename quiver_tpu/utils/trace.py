@@ -34,6 +34,7 @@ __all__ = [
     "get_logger",
     "info_once",
     "reset_once",
+    "warn_once",
     "start_trace",
     "stop_trace",
 ]
@@ -179,8 +180,23 @@ def info_once(key: str, msg: str, *args, child: str | None = None) -> None:
     get_logger(child).info(msg, *args)
 
 
+def warn_once(key: str, msg: str, *args, child: str | None = None) -> None:
+    """Log ``msg`` at WARNING level exactly once per process per ``key``.
+
+    The fail-safe-degradation companion to :func:`info_once`: shared
+    on-disk caches (kernel elections, AOT serving executables) treat any
+    corrupt/truncated file as a miss and recompute — that degradation
+    must reach the operator ONCE, not once per lookup on a hot path.
+    """
+    if key in _ONCE_KEYS:
+        return
+    _ONCE_KEYS.add(key)
+    get_logger(child).warning(msg, *args)
+
+
 def reset_once() -> None:
-    """Clear :func:`info_once`'s once-per-process memory.
+    """Clear :func:`info_once`/:func:`warn_once`'s once-per-process
+    memory.
 
     For test fixtures: without this, one-shot log state leaks across tests
     in the same process and log-assertion tests become order-dependent

@@ -55,6 +55,12 @@ SECTIONS = [
     ("quiver_tpu.serving",
      "Online inference serving — deadline-aware micro-batching over "
      "AOT-compiled ladder programs"),
+    ("quiver_tpu.serving.aot",
+     "Persisted AOT executables — fingerprint-keyed disk cache for "
+     "compile-free cold start"),
+    ("quiver_tpu.serving.fleet",
+     "Serving fleet — replica scale-out over one shared executable "
+     "cache with SLO-class admission control"),
     ("quiver_tpu.control",
      "quiver-ctl — telemetry-driven cache & routing control plane"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),

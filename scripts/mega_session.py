@@ -91,6 +91,7 @@ ORDER = [
     ("rgcn", 900),
     ("infer-layerwise", 900),
     ("serve-latency", 900),
+    ("serve-fleet", 900),
     ("saint-node", 900),
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),

@@ -119,6 +119,46 @@ def test_shared_cache_holds_both_elections(fresh_election, monkeypatch):
     assert "gbps" not in blob  # legacy keys dropped on rewrite
 
 
+def test_corrupt_cache_fails_safe_with_one_warning(fresh_election,
+                                                   monkeypatch, caplog):
+    """A corrupt/truncated shared cache file degrades to re-election with
+    a single WARNING — never a raise on the gather/sample path — and the
+    re-election's atomic republish heals the file (ISSUE 17 satellite:
+    the serving AOT cache shares this tolerant loader)."""
+    import logging
+
+    fresh_election.write_text('{"gather": {"kernel": "pal')  # truncated
+    monkeypatch.setattr(F, "_pallas_gather_usable", lambda: True)
+    monkeypatch.setattr(
+        F, "_measure_gather_gbps",
+        lambda k, **kw: {"xla": 2.0, "pallas": 8.0}[k])
+    with caplog.at_level(logging.WARNING, logger="quiver_tpu"):
+        assert F.GATHER_ELECTION.elect() == "pallas"
+    assert F.GATHER_ELECTION.result["how"] == "measured"
+    warns = [r for r in caplog.records if "unreadable" in r.getMessage()]
+    assert len(warns) == 1, [r.getMessage() for r in caplog.records]
+
+    # the same corrupt read (load before store) happens again inside
+    # _store's read-merge — still only ONE warning per process...
+    # and the republish over the bad file is valid, nested JSON again
+    blob = json.loads(fresh_election.read_text())
+    assert blob["gather"]["kernel"] == "pallas"
+
+    # a fresh process (reset) now trusts the healed cache
+    F.GATHER_ELECTION.reset()
+
+    def boom(k, **kw):
+        raise AssertionError("re-measured despite healed disk cache")
+
+    monkeypatch.setattr(F, "_measure_gather_gbps", boom)
+    assert F.GATHER_ELECTION.elect() == "pallas"
+    assert F.GATHER_ELECTION.result["how"] == "disk cache"
+    # no temp residue from the atomic publish
+    residue = [p.name for p in fresh_election.parent.iterdir()
+               if ".tmp." in p.name]
+    assert not residue, residue
+
+
 def test_env_knobs_pinned_at_first_use(fresh_election, monkeypatch):
     """QUIVER_GATHER_KERNEL / QUIVER_ELECTION_CACHE resolve ONCE per
     process: flipping them after the first use is inert without a cache
