@@ -62,6 +62,25 @@ def main():
         "(per-row absmax quantization, dequant on gather) quarters them",
     )
     p.add_argument(
+        "--store", default="ram", choices=["ram", "mmap", "pread"],
+        help="feature residency: ram = the in-RAM tiered Feature; mmap/"
+        "pread = the disk-backed MmapFeatureStore (quiver-ooc) with the "
+        "cold tier window-read off a raw-format dir through the async "
+        "stager — mmap maps the row file, pread uses positioned reads "
+        "(bounded address space, the rlimit-drill mode). Gathers are "
+        "bitwise-identical across all three; replicate policy only",
+    )
+    p.add_argument(
+        "--ooc-window", type=int, default=4096, metavar="ROWS",
+        help="--store mmap/pread: rows per disk read window (readahead "
+        "granularity)",
+    )
+    p.add_argument(
+        "--ooc-cache-windows", type=int, default=64, metavar="N",
+        help="--store mmap/pread: stager LRU capacity in windows (bounds "
+        "resident staging bytes at N * window * row bytes)",
+    )
+    p.add_argument(
         "--replicate-budget", default="0", metavar="BYTES",
         help="per-chip byte budget for the L0 replicated super-hot tier "
         "(same parser as device_cache_size, e.g. '16M'): the top-degree "
@@ -93,6 +112,16 @@ def main():
     if args.controller and args.policy != "shard":
         p.error("--controller requires --policy shard (repin is the "
                 "sharded store's actuator)")
+    if args.store != "ram":
+        if args.policy != "replicate":
+            p.error("--store mmap/pread requires --policy replicate (the "
+                    "disk tier backs the replicated store's cold rows)")
+        if args.stream:
+            p.error("--store mmap/pread is eager (host-staged disk "
+                    "reads); the fused --stream lane needs --store ram")
+        if args.dtype == "bf16":
+            p.error("--store mmap/pread supports f32 and int8 (the raw "
+                    "writer mirrors Feature's quantize path)")
     run_guarded(lambda: _body(args), args)
 
 
@@ -109,7 +138,25 @@ def _body(args):
     budget = int(args.cache_ratio * n) * f * 4
 
     dtype = {"f32": None, "bf16": "bfloat16", "int8": "int8"}[args.dtype]
-    if args.policy == "replicate":
+    if args.store != "ram":
+        import os
+        import tempfile
+
+        from quiver_tpu.ooc import MmapFeatureStore
+
+        raw_dir = os.path.join(
+            tempfile.mkdtemp(prefix="quiver-ooc-bench-"), "rows"
+        )
+        t0 = time.time()
+        MmapFeatureStore.write(raw_dir, feat, device_cache_size=budget,
+                               csr_topo=topo, dtype=dtype)
+        log(f"raw feature dir written in {time.time()-t0:.1f}s: {raw_dir}")
+        store = MmapFeatureStore(
+            raw_dir, kernel=args.kernel, access=args.store,
+            window_rows=args.ooc_window,
+            cache_windows=args.ooc_cache_windows,
+        )
+    elif args.policy == "replicate":
         store = Feature(
             device_cache_size=budget, csr_topo=topo, kernel=args.kernel,
             dtype=dtype, replicate_budget=args.replicate_budget,
@@ -176,6 +223,10 @@ def _body(args):
     total_bytes = 0
     t0 = time.time()
     for i in range(args.iters):
+        if args.store != "ram":
+            # the training pipeline's overlap seam: batch i+1's cold
+            # windows dispatch while batch i's gather runs
+            store.prefetch(batches[(i + 1) % len(batches)])
         res = fetch(jnp.asarray(batches[i % len(batches)]))
         total_bytes += res.shape[0] * (
             res.shape[1] * stored_itemsize + row_overhead
@@ -207,8 +258,10 @@ def _body(args):
         gather_batch=args.gather_batch,
         dispatch="percall",
         routed=getattr(args, "routed", False),
+        store=args.store,
         **_tier_hit_rates(store),
         **_routed_extras(store, routed_model),
+        **_ooc_extras(args, store),
     )
     # metrics.jsonl artifact: the store's registry snapshots (tier hits)
     # plus the hot tier's (routed overflow), attributed to this lane
@@ -300,6 +353,21 @@ def _controller_lane(args, store, topo):
         **_tier_hit_rates(store),
     )
     write_metrics(store, ctl, lane="feature-controller", policy=args.policy)
+
+
+def _ooc_extras(args, store):
+    """Ledger extras for a disk-backed (--store mmap/pread) run: the
+    stager's lifetime read/readahead counters and the exposed blocking
+    share of disk cost."""
+    if args.store == "ram" or getattr(store, "stager", None) is None:
+        return {}
+    st = store.stager
+    return {
+        "ooc_window_rows": st.window_rows,
+        "ooc_page_reads": st.page_reads_total,
+        "ooc_readahead_hits": st.readahead_hits_total,
+        "ooc_stage_wait_s": round(st.stage_wait_total, 4),
+    }
 
 
 def _routed_comm_model(args, store, h0: float = 0.0):

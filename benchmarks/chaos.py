@@ -40,7 +40,14 @@ layer guarantees (quiver_tpu/resilience/):
   a replica joins MID-TRAFFIC, warms every ladder program from the
   shared persisted AOT-executable cache with ZERO compiles, and serves
   responses bitwise-identical to the already-running replica for the
-  same (node, seq) stream (and to the direct single-query oracle).
+  same (node, seq) stream (and to the direct single-query oracle);
+* **ooc**: the disk-tier drill (quiver_tpu/ooc/) — mid-epoch transient
+  disk-read failures are absorbed by the AsyncStager's bounded backoff
+  (epoch completes, loss trajectory bit-identical to the fault-free
+  disk run), and a TORN raw directory (COMMIT marker missing) raises
+  ``CorruptRawDir`` at load, is quarantined aside, and the loader falls
+  back to the legacy ``.npz`` of the same topology with sampling
+  bit-identical to the original.
 
 Any drill failure raises (the session marks the job failed); success
 prints one ``CHAOS <drill> OK`` line per drill. ``--drills`` selects a
@@ -58,7 +65,7 @@ import numpy as np
 from benchmarks import common
 
 DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage",
-          "pipeline", "mutate", "scale-out")
+          "pipeline", "mutate", "scale-out", "ooc")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -673,6 +680,117 @@ def drill_mutate(topo_seed_graph, feat, local_batch, seed):
     )
 
 
+def drill_ooc(topo_shared, feat, labels, local_batch, seed):
+    """Disk-tier chaos: transient read faults mid-epoch are retried by
+    the AsyncStager's backoff (trajectory bit-identical to the
+    fault-free disk run); a torn raw dir is quarantined and the loader
+    falls back to the legacy .npz with sampling bit-identical."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from quiver_tpu import CSRTopo, GraphSageSampler
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.ooc import (
+        CorruptRawDir,
+        MmapFeatureStore,
+        quarantine_raw_dir,
+    )
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DataParallelTrainer
+
+    # private topology: the store's degree reorder writes feature_order,
+    # which must not leak into the other drills' shared graph
+    topo = CSRTopo(indptr=topo_shared.indptr, indices=topo_shared.indices)
+    n, d = feat.shape
+    lab = jnp.asarray(labels)
+    idx = np.random.default_rng(seed).integers(
+        0, n, 6 * local_batch * jax.device_count()
+    )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rows = os.path.join(tmp, "rows")
+        MmapFeatureStore.write(
+            rows, feat, device_cache_size=max(n // 5, 1) * d * 4,
+            csr_topo=topo,
+        )
+
+        def run_epoch(inject_faults):
+            store = MmapFeatureStore(rows, window_rows=16, cache_windows=8,
+                                     retries=3, backoff=1e-3)
+            injected = set()
+            if inject_faults:
+                real = store.stager._read_window
+
+                def flaky(window):
+                    # first read of the first 3 distinct windows fails
+                    # once; the stager's backoff re-read succeeds
+                    if len(injected) < 3 and window not in injected:
+                        injected.add(window)
+                        raise OSError(
+                            f"injected disk fault on window {window}"
+                        )
+                    return real(window)
+
+                store.stager._read_window = flaky
+            sampler = GraphSageSampler(topo, [5, 5], seed=3,
+                                       seed_capacity=local_batch)
+            trainer = DataParallelTrainer(
+                make_mesh(), sampler, store,
+                GraphSAGE(hidden=16, num_classes=4, num_layers=2),
+                optax.sgd(1e-2), local_batch=local_batch,
+            )
+            params, opt = trainer.init(jax.random.PRNGKey(0))
+            params, opt, loss, steps = trainer.train_epoch(
+                params, opt, idx, lab, jax.random.PRNGKey(1),
+                rng=np.random.default_rng(seed),
+            )
+            retries = store.stager.read_retries_total
+            store.close()
+            return float(loss), int(steps), retries, len(injected)
+
+        clean_loss, clean_steps, _, _ = run_epoch(False)
+        loss, steps, retries, injected = run_epoch(True)
+        assert injected == 3, f"only {injected}/3 faults injected"
+        assert retries == injected, \
+            f"stager retries {retries} != {injected} injected faults"
+        assert steps == clean_steps, f"epoch delivered {steps}/{clean_steps}"
+        assert loss == clean_loss, \
+            "recovered epoch diverged from the fault-free disk run"
+
+        # torn publish: COMMIT marker missing -> quarantine + npz fallback
+        raw = os.path.join(tmp, "topo.raw")
+        npz = os.path.join(tmp, "topo.npz")
+        topo.save(raw, format="raw")
+        topo.save(npz)
+        os.remove(os.path.join(raw, "COMMIT"))
+        torn = False
+        try:
+            CSRTopo.load(raw, mmap=True)
+        except CorruptRawDir:
+            torn = True
+            quarantine_raw_dir(raw)
+            recovered = CSRTopo.load(npz)
+        assert torn, "torn raw dir loaded without complaint"
+        assert not os.path.exists(raw), "torn raw dir not quarantined"
+        # fresh same-seed samplers: first draws are deterministic, so the
+        # fallback topology must reproduce the original stream bitwise
+        seeds = np.random.default_rng(seed).integers(0, n, local_batch)
+        a = GraphSageSampler(topo, [5, 5], seed=3,
+                             seed_capacity=local_batch).sample(seeds)
+        b = GraphSageSampler(recovered, [5, 5], seed=3,
+                             seed_capacity=local_batch).sample(seeds)
+        assert np.array_equal(np.asarray(a.n_id), np.asarray(b.n_id)), \
+            "sampling off the npz fallback diverged from the original"
+    common.log(
+        f"CHAOS ooc OK ({retries} mid-epoch disk faults retried, epoch "
+        f"{steps}/{clean_steps} steps bit-identical to fault-free; torn "
+        "raw dir quarantined, npz fallback sampling bit-identical)"
+    )
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=2000)
@@ -720,6 +838,8 @@ def main():
             drill_mutate(topo, feat, args.local_batch, args.seed)
         if "scale-out" in selected:
             drill_scale_out(topo, feat, args.seed)
+        if "ooc" in selected:
+            drill_ooc(topo, feat, labels, args.local_batch, args.seed)
         common.log(f"CHAOS all drills passed ({', '.join(selected)})")
         return 0
 

@@ -118,6 +118,14 @@ JOBS = [
      "bronze SLO classes with per-class p99 and shed-before-gold "
      "admission; the reference's many-frontends-one-IPC-Feature pattern "
      "taken to whole-program replay"),
+    ("feature-ooc", "benchmarks.ooc_drill", [],
+     "out-of-core epoch under a HARD RLIMIT_AS budget: graph on disk at "
+     ">= 4x the address-space headroom, pread-mode MmapFeatureStore + "
+     "AsyncStager window readahead, 2-virtual-device CPU mesh in a "
+     "subprocess (the limit is process-wide and irreversible); gates: "
+     "epoch completes, readahead_hits > 0, recompiles_steady = 0 — the "
+     "reference's closest analogue is mmap'd papers100M features over "
+     "UVA, which it never bounded or measured"),
     ("saint-node", "benchmarks.bench_saint", ["--sampler", "node"],
      "no reference baseline (SAINT never landed there)"),
     ("validation", "benchmarks.tpu_validation", [],
@@ -378,7 +386,8 @@ def write_outputs(results, out, smoke, merge=False):
                                "topo_shrink", "comm_reduction",
                                "overlap_efficiency", "scan_speedup",
                                "recompiles_steady", "pipeline_depth",
-                               "prefetch", "replicas", "p99_gold_ms",
+                               "prefetch", "store", "graph_over_budget",
+                               "readahead_hits", "replicas", "p99_gold_ms",
                                "p99_bronze_ms", "shed_gold", "shed_bronze",
                                "cold_start_s", "warm_join_s")}
             if extras:

@@ -37,6 +37,13 @@ from .obs import (
     StepTimeline,
     profile_epoch,
 )
+from .ooc import (
+    AsyncStager,
+    CorruptRawDir,
+    MmapFeatureStore,
+    quarantine_raw_dir,
+    verify_raw_dir,
+)
 from .resilience import (
     CircuitBreaker,
     CorruptCheckpoint,
@@ -120,6 +127,11 @@ __all__ = [
     "MetricSnapshot",
     "StepTimeline",
     "profile_epoch",
+    "MmapFeatureStore",
+    "AsyncStager",
+    "CorruptRawDir",
+    "verify_raw_dir",
+    "quarantine_raw_dir",
     "FaultPlan",
     "Preemption",
     "TransientFault",

@@ -39,6 +39,7 @@ from ..obs.export import write_jsonl
 from ..obs.registry import (
     CTRL_ALPHA_CHANGES,
     CTRL_DECISIONS,
+    CTRL_OOC_PROMOTIONS,
     CTRL_REPINS,
     CTRL_SPLIT_MOVES,
     MetricsRegistry,
@@ -203,8 +204,14 @@ class CacheController:
             doc="routed_alpha changes (grow on overflow OR shrink on "
                 "sustained slack)",
         )
+        self.metrics.counter(
+            CTRL_OOC_PROMOTIONS, unit="restages",
+            doc="disk-tier host-cache restages to a measured-hottest "
+                "row set (out-of-core stores)",
+        )
         self._counts = {CTRL_DECISIONS: 0, CTRL_REPINS: 0,
-                        CTRL_SPLIT_MOVES: 0, CTRL_ALPHA_CHANGES: 0}
+                        CTRL_SPLIT_MOVES: 0, CTRL_ALPHA_CHANGES: 0,
+                        CTRL_OOC_PROMOTIONS: 0}
         self.decisions: list[dict] = []  # in-memory audit trail
 
     # -- construction helpers ------------------------------------------------
@@ -354,12 +361,78 @@ class CacheController:
         )
         return True
 
+    def maybe_promote(self, store) -> bool:
+        """Restage an out-of-core store's host cold cache to the
+        sketch's measured-hottest DISK rows.
+
+        The disk-tier analogue of :meth:`maybe_repin`, one level down:
+        heavy hitters whose translated rows fall past ``hot_rows`` live
+        on disk; the top ``host_cache_rows`` of them by measured mass
+        earn promotion into host RAM (:meth:`~quiver_tpu.ooc.store
+        .MmapFeatureStore.restage`), and rows that lost their heat spill
+        back to disk-only by dropping out of the set (their bytes were
+        never mutated — forgetting the copy IS the demotion). Same
+        ``repin_min_gain`` hysteresis: the cache only moves when the
+        promoted set's predicted hit mass beats the currently staged
+        set's by the threshold, so noise cannot thrash the disk. Audited
+        under ``ctrl.ooc_promotions``. Returns True when a restage was
+        applied.
+        """
+        if self.frozen or self.sketch is None:
+            return False
+        budget = int(getattr(store, "host_cache_rows", 0))
+        if budget <= 0 or not hasattr(store, "restage"):
+            return False
+        hitters = self.sketch.state()["hitters"]
+        if not hitters:
+            return False
+        total = sum(hitters.values())
+        if total <= 0:
+            return False
+        hot_rows = int(getattr(store, "hot_rows", 0))
+        order = store.feature_order
+        order = None if order is None else np.asarray(order)
+        ids = np.fromiter(hitters.keys(), np.int64, len(hitters))
+        mass = np.fromiter(hitters.values(), np.float64, len(hitters))
+        t = ids if order is None else order[ids].astype(np.int64)
+        disk = t >= hot_rows  # hitters whose rows live past the HBM tier
+        if not disk.any():
+            return False
+        cold_local = t[disk] - hot_rows
+        cold_mass = mass[disk]
+        top = np.argsort(-cold_mass, kind="stable")[:budget]
+        target = float(cold_mass[top].sum())
+        staged = store.staged_ids
+        current = (
+            float(cold_mass[np.isin(cold_local, staged)].sum())
+            if staged.size else 0.0
+        )
+        gain = (target - current) / total
+        if staged.size and gain < self.repin_min_gain:
+            return False
+        resident = store.restage(cold_local[top])
+        record = {
+            "budget": budget, "staged": resident,
+            "hit_share_before": current / total,
+            "hit_share_after": target / total, "gain": gain,
+        }
+        if self.cost is not None:
+            record["predicted"] = self.cost.predict_disk(
+                self.sketch, hot_rows, resident
+            )
+        self._audit(CTRL_OOC_PROMOTIONS, "ooc_promote", record)
+        return True
+
     def end_epoch(self, feature=None, trainer=None) -> None:
-        """Epoch-boundary hook: consider a repin on the epoch's
-        accumulated heat, then EMA-decay the sketch toward the current
-        traffic mix."""
+        """Epoch-boundary hook: consider a re-tier on the epoch's
+        accumulated heat — an L0 repin for in-RAM stores, a disk-to-host
+        promotion for out-of-core ones — then EMA-decay the sketch
+        toward the current traffic mix."""
         if feature is not None:
-            self.maybe_repin(feature, trainer)
+            if hasattr(feature, "restage"):
+                self.maybe_promote(feature)
+            else:
+                self.maybe_repin(feature, trainer)
         if self.sketch is not None:
             self.sketch.decay()
 

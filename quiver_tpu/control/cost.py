@@ -109,6 +109,9 @@ class CostModel:
         self._t_fixed = 0.0
         self._t_lane = 0.0
         self.calibrated = False
+        self._t_window = 0.0    # exposed seconds per disk window read
+        self._window_rows = 1
+        self.disk_calibrated = False
 
     def calibrate(self, timeline, stage: str = "step",
                   alpha: float | None = None, h0: float = 0.0) -> bool:
@@ -141,5 +144,54 @@ class CostModel:
         if self.calibrated:
             out["est_step_s"] = (
                 self._t_fixed + self._t_lane * lanes["lanes_per_hop"]
+            )
+        return out
+
+    # -- disk tier (quiver-ooc) ----------------------------------------------
+
+    def calibrate_disk(self, timeline, stager,
+                       stage: str = "ooc.stage_wait") -> bool:
+        """Anchor the disk-read coefficient: EXPOSED seconds per window
+        read, from the measured ``ooc.stage_wait`` stage total over the
+        stager's issued window reads. Exposed (not raw read) time is the
+        right unit — reads the :class:`~quiver_tpu.ooc.stager
+        .AsyncStager` hid under compute cost the step nothing, and the
+        controller is ranking promotions by step-time saved. Returns
+        False (model unchanged) until a wait has been observed."""
+        stats = timeline.summary().get(stage)
+        reads = int(getattr(stager, "page_reads_total", 0))
+        if stats is None or getattr(stats, "count", 0) == 0 or reads == 0:
+            return False
+        self._t_window = float(stats.total) / reads
+        self._window_rows = max(int(getattr(stager, "window_rows", 1)), 1)
+        self.disk_calibrated = True
+        return True
+
+    def predict_disk(self, sketch, hot_rows: int,
+                     resident_rows: int = 0) -> dict:
+        """Predicted per-step disk exposure for a candidate host-cache
+        size. The sketch's heat mass ABOVE ``hot_rows + resident_rows``
+        (translated row space: rows neither in HBM nor promoted to the
+        host cache) is the miss mass that must come off disk; when
+        :meth:`calibrate_disk` has run, that converts to estimated
+        exposed seconds per observed step via the measured
+        window-read cost."""
+        total = sketch.total_mass
+        resident = int(hot_rows) + int(resident_rows)
+        if total <= 0:
+            return {"miss_mass": 0.0, "hit_disk": 0.0,
+                    "resident_rows": resident}
+        below = sketch.bin_mass_below(resident)
+        miss = max(total - below, 0.0)
+        out = {
+            "miss_mass": miss,
+            "hit_disk": miss / total,
+            "resident_rows": resident,
+        }
+        if self.disk_calibrated:
+            # miss rows -> window reads (each window amortizes
+            # window_rows rows in the best — staged-layout — case)
+            out["est_disk_s_per_obs"] = (
+                self._t_window * miss / total / self._window_rows
             )
         return out

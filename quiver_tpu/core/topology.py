@@ -185,6 +185,7 @@ class CSRTopo:
         self._edge_weight = None
         self._cum_weights = None
         self._edge_time = None
+        self._max_degree = None  # lazy cache (manifest-seeded on raw loads)
         # streaming-mutation version: bumped ONCE per committed transaction
         # (quiver_tpu.streaming); device placements capture the version they
         # were built from and raise VersionMismatchError instead of serving
@@ -366,6 +367,7 @@ class CSRTopo:
         self._indptr = indptr
         self._indices = indices
         self._eid = None
+        self._max_degree = None  # degrees changed; re-derive on demand
         self._version += 1
 
     @property
@@ -374,7 +376,12 @@ class CSRTopo:
 
     @property
     def max_degree(self) -> int:
-        return int(self.degree.max(initial=0))
+        # cached: samplers read this per construction, and on an mmap'd
+        # raw load the O(N) degree scan would page the whole indptr in —
+        # the manifest carries the value instead (invalidated on mutation)
+        if self._max_degree is None:
+            self._max_degree = int(self.degree.max(initial=0))
+        return self._max_degree
 
     @property
     def node_count(self) -> int:
@@ -389,21 +396,67 @@ class CSRTopo:
 
     # -- persistence --------------------------------------------------------
 
-    def save(self, path: str) -> None:
-        """Persist the topology (CSR + eid + weights + feature_order) as
-        one ``.npz``. The reference's users ``torch.save`` their CSR
-        preprocessing artifacts (benchmarks/ogbn-papers100M/preprocess.py);
-        this is the same round-trip without a torch dependency.
-
-        Atomic publish (the checkpoint-store idiom, utils/checkpoint.py):
-        the bytes land in a same-directory temp file, are fsynced, and one
-        ``os.replace`` renames them into place — a crash mid-save can
-        leave a stale temp file but never a torn topology at ``path``."""
+    def _persist_arrays(self) -> dict:
+        """Every array worth round-tripping, keyed by canonical name.
+        ``cum_weights`` rides along so a load never pays the O(E) prefix
+        recompute; the raw format's mmap loads depend on that."""
         arrays = {"indptr": self._indptr, "indices": self._indices}
-        for name in ("eid", "edge_weight", "edge_time", "feature_order"):
+        for name in ("eid", "edge_weight", "cum_weights", "edge_time",
+                     "feature_order"):
             v = getattr(self, f"_{name}")
             if v is not None:
                 arrays[name] = v
+        return arrays
+
+    def save(self, path: str, format: str = "npz") -> None:
+        """Persist the topology (CSR + eid + weights + feature_order).
+
+        ``format="npz"`` (default) writes one ``.npz`` — the reference's
+        users ``torch.save`` their CSR preprocessing artifacts
+        (benchmarks/ogbn-papers100M/preprocess.py); this is the same
+        round-trip without a torch dependency. A ``_integrity`` member
+        (JSON, per-array CRC32 via the raw-manifest helper) rides inside
+        the zip so :meth:`load` can catch silent byte corruption, not
+        just zip-level truncation.
+
+        ``format="raw"`` writes the mmap-native directory layout
+        (:mod:`quiver_tpu.ooc.format`): per-array uncompressed ``.npy``
+        files + CRC32 manifest + COMMIT marker. This is the out-of-core
+        path — :meth:`load` with ``mmap=True`` backs ``indptr``/
+        ``indices``/edge attrs onto ``np.memmap`` so resident bytes stay
+        O(touched pages). Derived state (``cum_weights``, ``max_degree``)
+        is persisted so the load path never runs an O(E) or O(N) scan.
+
+        Both formats publish atomically (same-filesystem temp + fsync +
+        ``os.replace``): a crash mid-save can leave a stale temp behind
+        but never a torn artifact at ``path``."""
+        if format == "raw":
+            from ..ooc.format import save_raw_dir  # lazy: ooc sits above core
+
+            save_raw_dir(path, self._persist_arrays(), meta={
+                "kind": "csr-topo",
+                "node_count": self.node_count,
+                "edge_count": self.edge_count,
+                "max_degree": self.max_degree,
+                "version": self._version,
+            })
+            return
+        if format != "npz":
+            raise ValueError(f'format must be "npz" or "raw", got {format!r}')
+        from ..resilience.integrity import array_checksum  # lazy (cycle)
+        import json
+
+        arrays = self._persist_arrays()
+        arrays.pop("cum_weights", None)  # npz loads re-derive (legacy shape)
+        integrity = json.dumps(
+            {name: array_checksum(v) for name, v in arrays.items()},
+            sort_keys=True,
+        )
+        # JSON-as-uint8 smuggles the checksums through np.savez without
+        # allow_pickle; readers that predate it just see an extra member
+        arrays["_integrity"] = np.frombuffer(
+            integrity.encode(), dtype=np.uint8
+        )
         tmp = f"{path}.tmp-{os.getpid()}"
         try:
             with open(tmp, "wb") as fh:  # exact filename, no np suffixing
@@ -419,17 +472,65 @@ class CSRTopo:
             raise
 
     @classmethod
-    def load(cls, path: str) -> "CSRTopo":
-        """Rebuild a :meth:`save`'d topology. Weights re-derive their
-        per-row prefix sums; they are stored CSR-ordered, so coo_order is
-        False on the way back in.
+    def _from_raw(cls, arrays: dict, meta: dict) -> "CSRTopo":
+        """Assemble a topology from raw-format arrays WITHOUT running
+        ``__init__`` — its O(N)/O(E) boundary scans and int64 coercion
+        would page every byte of an mmap'd load in, defeating the
+        out-of-core point. Safe because the arrays were validated on the
+        way INTO :func:`~quiver_tpu.ooc.format.save_raw_dir` (they came
+        from a live CSRTopo) and the format's manifest pins their exact
+        sizes; run ``ooc.verify_raw_dir`` for a full byte-level sweep."""
+        topo = cls.__new__(cls)
+        topo._indptr = arrays["indptr"]
+        topo._indices = arrays["indices"]
+        topo._eid = arrays.get("eid")
+        topo._feature_order = arrays.get("feature_order")
+        topo._edge_weight = arrays.get("edge_weight")
+        topo._cum_weights = arrays.get("cum_weights")
+        topo._edge_time = arrays.get("edge_time")
+        topo._max_degree = (
+            int(meta["max_degree"]) if "max_degree" in meta else None
+        )
+        topo._version = int(meta.get("version", 0))
+        return topo
 
-        A truncated, corrupt, or foreign ``.npz`` raises a clear
-        ``ValueError`` naming the file — np.load's raw zipfile errors (or
-        a KeyError three stack frames later) left the operator guessing
-        which artifact was bad."""
+    @classmethod
+    def load(cls, path: str, mmap: bool = False) -> "CSRTopo":
+        """Rebuild a :meth:`save`'d topology (either format — a directory
+        at ``path`` is the raw layout, a file is the legacy ``.npz``).
+
+        ``mmap=True`` (raw format only) backs every array onto read-only
+        ``np.memmap``: resident bytes stay O(touched pages) and no
+        validation scan runs (see :meth:`_from_raw`) — the papers100M
+        path, where the CSR alone outgrows host RAM. Eager raw loads
+        (``mmap=False``) run the full CRC32 sweep instead.
+
+        Legacy ``.npz``: weights re-derive their per-row prefix sums
+        (stored CSR-ordered, so coo_order is False on the way back in);
+        when the archive carries a ``_integrity`` member the per-array
+        CRC32s are verified, so silent byte corruption fails as loudly
+        as zip-level truncation. A truncated, corrupt, or foreign file
+        raises a clear ``ValueError`` naming the artifact — np.load's
+        raw zipfile errors (or a KeyError three stack frames later) left
+        the operator guessing which file was bad."""
         import zipfile
 
+        if os.path.isdir(path):
+            from ..ooc.format import load_raw_dir  # lazy: ooc sits above core
+
+            arrays, meta = load_raw_dir(path, mmap=mmap)
+            if meta.get("kind") != "csr-topo":
+                raise ValueError(
+                    f"{path}: raw dir holds {meta.get('kind')!r}, not a "
+                    f"csr-topo artifact"
+                )
+            return cls._from_raw(arrays, meta)
+        if mmap:
+            raise ValueError(
+                f"{path}: mmap loading needs the raw directory format — "
+                f'save with format="raw" (a legacy .npz is a zip that '
+                f"must be decompressed into RAM)"
+            )
         try:
             z = np.load(path)
         except (OSError, ValueError, EOFError, zipfile.BadZipFile) as e:
@@ -438,6 +539,7 @@ class CSRTopo:
                 f"corrupt, or not an .npz ({type(e).__name__}: {e})"
             ) from None
         with z:
+            cls._verify_npz_integrity(path, z)
             missing = [k for k in ("indptr", "indices") if k not in z.files]
             if missing:
                 raise ValueError(
@@ -462,6 +564,45 @@ class CSRTopo:
             if "feature_order" in z.files:
                 topo.feature_order = z["feature_order"]
         return topo
+
+    @staticmethod
+    def _verify_npz_integrity(path: str, z) -> None:
+        """Check the ``_integrity`` CRC32 record an npz :meth:`save`
+        embeds (absent on pre-record archives — those load unverified,
+        backward compatible). Raises ``ValueError`` naming the first
+        corrupt array."""
+        if "_integrity" not in z.files:
+            return
+        import json
+        import zipfile
+
+        from ..resilience.integrity import array_checksum  # lazy (cycle)
+
+        try:
+            expected = json.loads(bytes(z["_integrity"]).decode())
+        except (ValueError, UnicodeDecodeError, zipfile.BadZipFile) as e:
+            raise ValueError(
+                f"{path}: unreadable _integrity record ({e})"
+            ) from None
+        for name, crc in expected.items():
+            if name not in z.files:
+                raise ValueError(
+                    f"{path}: _integrity covers array {name!r} but the "
+                    f"archive lacks it — truncated or tampered save"
+                )
+            try:
+                got = array_checksum(z[name])
+            except (OSError, ValueError, EOFError, zipfile.BadZipFile) as e:
+                # the zip's own member CRC can fire first on corrupt bytes
+                raise ValueError(
+                    f"{path}: array {name!r} unreadable — corrupt bytes "
+                    f"({type(e).__name__}: {e})"
+                ) from None
+            if got != int(crc):
+                raise ValueError(
+                    f"{path}: checksum mismatch on array {name!r} "
+                    f"(stored {crc}, computed {got}) — corrupt bytes"
+                )
 
     # -- device placement ---------------------------------------------------
 

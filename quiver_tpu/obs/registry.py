@@ -53,6 +53,7 @@ __all__ = [
     "GUARD_NONFINITE",
     "PREFETCH_RETRIES",
     "PREFETCH_SKIPS",
+    "PREFETCH_QUEUE_DEPTH",
     "DEGRADED_LOOKUPS",
     "DELTAS_QUARANTINED",
     "DELTAS_COMMITTED",
@@ -71,6 +72,10 @@ __all__ = [
     "CTRL_REPINS",
     "CTRL_SPLIT_MOVES",
     "CTRL_ALPHA_CHANGES",
+    "CTRL_OOC_PROMOTIONS",
+    "OOC_STAGE_WAIT",
+    "OOC_PAGE_READS",
+    "OOC_READAHEAD_HITS",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -94,7 +99,18 @@ GUARD_NONFINITE = "resilience.nonfinite_grads"
 # circuit breaker's fallback instead of crashing the step
 PREFETCH_RETRIES = "prefetch.retries"
 PREFETCH_SKIPS = "prefetch.skipped_batches"
+# in-flight prefetch dispatches at the most recent queue transition — the
+# gauge that distinguishes "pipeline keeps the depth budget full" from
+# "consumer is starving the worker" (lifetime counters can't)
+PREFETCH_QUEUE_DEPTH = "prefetch.queue_depth"
 DEGRADED_LOOKUPS = "resilience.degraded_lookups"
+# out-of-core disk tier (quiver_tpu/ooc): seconds a gather spent BLOCKED
+# on window reads (the exposed share of disk cost — hidden reads never
+# land here), window reads issued to disk, and requested rows served
+# from an already-staged window (the readahead working)
+OOC_STAGE_WAIT = "ooc.stage_wait"
+OOC_PAGE_READS = "ooc.page_reads"
+OOC_READAHEAD_HITS = "ooc.readahead_hits"
 # streaming mutation layer (quiver_tpu/streaming): delta batches rejected
 # at the ingestion boundary or by a failed commit (quarantined with a
 # reason, never partially applied), delta batches merged by a published
@@ -143,6 +159,10 @@ CTRL_DECISIONS = "ctrl.decisions"
 CTRL_REPINS = "ctrl.repins"
 CTRL_SPLIT_MOVES = "ctrl.split_moves"
 CTRL_ALPHA_CHANGES = "ctrl.alpha_changes"
+# disk->host-cold promotion/demotion decisions over an out-of-core store
+# (quiver_tpu/ooc): one decision restages the whole host cold cache to
+# the sketch's measured-hottest disk rows
+CTRL_OOC_PROMOTIONS = "ctrl.ooc_promotions"
 
 _KINDS = ("counter", "gauge")
 

@@ -1,0 +1,48 @@
+"""quiver-ooc — out-of-core graph store: the disk tier below the ladder.
+
+The reference's UVA hierarchy ends at host RAM: a papers100M-class run
+assumes the full CSR and every cold feature row fit the host. This
+package adds the fourth storage tier below the existing L0/L1/cold
+ladder — disk — without changing a single gather's bytes:
+
+* :mod:`~quiver_tpu.ooc.format` — the mmap-native on-disk layout:
+  per-array uncompressed ``.npy`` files plus a CRC32 manifest and a
+  COMMIT marker, published atomically (tmp dir + fsync + ``os.replace``,
+  the ``resilience/integrity`` discipline). ``CSRTopo.save(path,
+  format="raw")`` / ``CSRTopo.load(path, mmap=True)`` ride it.
+* :class:`~quiver_tpu.ooc.store.MmapFeatureStore` — a disk-backed
+  feature store bitwise-identical to the in-RAM :class:`~quiver_tpu.
+  feature.feature.Feature` (same translated row space, same tiered
+  gather merge), with resident bytes O(touched pages), not O(graph).
+* :class:`~quiver_tpu.ooc.stager.AsyncStager` — bounded background
+  window reads with seeded retry/backoff (the Prefetcher's resilience
+  pattern), measured via ``ooc.stage_wait`` / ``ooc.page_reads`` /
+  ``ooc.readahead_hits``.
+
+quiver-ctl closes the loop one tier further down: the FreqSketch's
+measured heat decides which disk rows earn promotion into the host cold
+cache (:meth:`~quiver_tpu.control.controller.CacheController
+.maybe_promote`), audited like every other controller decision.
+"""
+
+from .format import (
+    RAW_FORMAT,
+    CorruptRawDir,
+    load_raw_dir,
+    quarantine_raw_dir,
+    save_raw_dir,
+    verify_raw_dir,
+)
+from .stager import AsyncStager
+from .store import MmapFeatureStore
+
+__all__ = [
+    "AsyncStager",
+    "CorruptRawDir",
+    "MmapFeatureStore",
+    "RAW_FORMAT",
+    "load_raw_dir",
+    "quarantine_raw_dir",
+    "save_raw_dir",
+    "verify_raw_dir",
+]

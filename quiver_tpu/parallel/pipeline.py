@@ -34,7 +34,11 @@ from typing import Callable, Iterable, Iterator, NamedTuple
 
 import numpy as np
 
-from ..obs.registry import PREFETCH_RETRIES, PREFETCH_SKIPS
+from ..obs.registry import (
+    PREFETCH_QUEUE_DEPTH,
+    PREFETCH_RETRIES,
+    PREFETCH_SKIPS,
+)
 
 __all__ = ["Batch", "PipelinedBatch", "Prefetcher"]
 
@@ -183,6 +187,12 @@ class Prefetcher:
                 doc="poisoned batches dropped after retries exhausted "
                     "(skip_policy='skip'; lifetime total)",
             )
+            metrics.gauge(
+                PREFETCH_QUEUE_DEPTH, unit="batches",
+                doc="batches currently in flight on the prefetch worker "
+                    "(pinned at `depth` while the pipeline keeps up; "
+                    "sagging below it means dispatch is the bottleneck)",
+            )
         self._jitter_rng = random.Random(retry_seed)
         self.retries_total = 0
         self.skips_total = 0
@@ -261,15 +271,26 @@ class Prefetcher:
         )
         inflight: collections.deque = collections.deque()
         it = iter(seed_stream)
+
+        def _note_depth() -> None:
+            # consumer-thread write; the worker never touches this gauge
+            if self.metrics is not None:
+                self.metrics.set(
+                    PREFETCH_QUEUE_DEPTH, np.int32(len(inflight))
+                )
+
         try:
             for seeds in it:
                 inflight.append(pool.submit(self._dispatch_resilient, seeds))
+                _note_depth()
                 if len(inflight) > self.depth:
                     batch = inflight.popleft().result()
+                    _note_depth()
                     if not isinstance(batch, _Skipped):
                         yield batch
             while inflight:
                 batch = inflight.popleft().result()
+                _note_depth()
                 if not isinstance(batch, _Skipped):
                     yield batch
         finally:

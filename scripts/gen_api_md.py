@@ -63,6 +63,9 @@ SECTIONS = [
      "cache with SLO-class admission control"),
     ("quiver_tpu.control",
      "quiver-ctl — telemetry-driven cache & routing control plane"),
+    ("quiver_tpu.ooc",
+     "quiver-ooc — out-of-core disk tier: raw mmap-native format, "
+     "disk-backed feature store, async window staging"),
     ("quiver_tpu.ops.sample", "Sampling ops (XLA)"),
     ("quiver_tpu.ops.reindex", "Dedup/reindex strategies"),
     ("quiver_tpu.models.layers", "Message-passing primitives"),

@@ -92,6 +92,9 @@ ORDER = [
     ("infer-layerwise", 900),
     ("serve-latency", 900),
     ("serve-fleet", 900),
+    # out-of-core drill runs in a CPU subprocess (RLIMIT_AS is process-
+    # wide and irreversible), so it burns no chip-window time
+    ("feature-ooc", 900),
     ("saint-node", 900),
     ("feature-shard-routed", 900),
     ("feature-shard-routed-capped", 900),

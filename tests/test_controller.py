@@ -438,3 +438,57 @@ def test_serve_retier_drill_and_state_survives_commit():
     final = server.serve(nodes[:4])
     for r in final:
         np.testing.assert_array_equal(r.result, server.oracle(r.node, r.seq))
+
+
+# -- FreqSketch eviction boundaries (satellite) -------------------------------
+
+
+def test_sketch_capacity_exactly_k_never_exceeded():
+    """At capacity exactly K, a new id evicts the minimum and INHERITS
+    its count (SpaceSaving's overestimate-never-underestimate), and the
+    hitter set never grows past K."""
+    sk = FreqSketch(100, top_k=3)
+    sk.observe_ids([10] * 5 + [11] * 3 + [12] * 2)  # fills exactly K=3
+    assert len(sk.state()["hitters"]) == 3
+    sk.observe_ids([13])  # K+1th distinct id
+    h = sk.state()["hitters"]
+    assert len(h) == 3  # capacity held
+    assert 12 not in h  # the minimum (count 2) was evicted
+    assert h[13] == 2 + 1  # newcomer inherited the victim's count
+    assert h[10] == 5 and h[11] == 3  # survivors untouched
+
+
+def test_sketch_equal_count_tie_breaks_by_id():
+    """top_rows orders equal counts by ascending node id (the sort key
+    is (-count, id)) — deterministic repin sets under uniform traffic."""
+    sk = FreqSketch(100, top_k=8)
+    sk.observe_ids([7, 3, 9, 1])  # all count 1
+    np.testing.assert_array_equal(sk.top_rows(4), [1, 3, 7, 9])
+    sk.observe_ids([9])  # 9 pulls ahead
+    np.testing.assert_array_equal(sk.top_rows(4), [9, 1, 3, 7])
+    # eviction respects the same floor: min of equal counts is a valid
+    # victim and the set stays exactly top_k wide
+    sk2 = FreqSketch(100, top_k=2)
+    sk2.observe_ids([5, 6])
+    sk2.observe_ids([4])
+    assert len(sk2.state()["hitters"]) == 2
+    assert sk2.state()["hitters"][4] == 2  # inherited 1 + own 1
+
+
+def test_sketch_degree_prior_decays_to_zero_under_no_traffic():
+    """A degree prior seeds the hitter set at low mass, and sustained
+    zero traffic EMA-decays it toward zero — stale priors cannot pin
+    rows forever once real traffic (or its absence) disagrees."""
+    sk = FreqSketch(100, top_k=16, decay=0.5)
+    sk.observe_prior(np.arange(100, dtype=np.float64))
+    before = sum(sk.state()["hitters"].values())
+    assert before > 0
+    assert sk.state()["hitters"][99] == 1.0  # scaled by the max weight
+    for _ in range(40):
+        sk.decay()
+    after = sum(sk.state()["hitters"].values())
+    assert after < before * 1e-10  # geometric collapse, never negative
+    assert after >= 0
+    # the decayed prior no longer outranks ONE real observed hit
+    sk.observe_ids([0])
+    assert sk.top_rows(1)[0] == 0

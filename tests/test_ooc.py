@@ -1,0 +1,538 @@
+"""quiver-ooc tests: raw format durability, mmap/pread stores bitwise-
+identical to the in-RAM Feature, staged reads under faults, and the
+disk-tier control loop.
+
+The contract under test is the tentpole's: moving the cold tier from
+host RAM to disk changes WHERE bytes come from and nothing else — same
+translated row space, same gathers, same sampled batches, same losses,
+at f32 and int8, single-device and through the 2-device data-parallel
+trainer."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from quiver_tpu import CSRTopo, GraphSageSampler
+from quiver_tpu.feature.feature import Feature
+from quiver_tpu.ooc import (
+    AsyncStager,
+    CorruptRawDir,
+    MmapFeatureStore,
+    load_raw_dir,
+    quarantine_raw_dir,
+    save_raw_dir,
+    verify_raw_dir,
+)
+
+
+def _graph(n=200, deg=6, seed=0):
+    rng = np.random.default_rng(seed)
+    ei = rng.integers(0, n, size=(2, deg * n)).astype(np.int64)
+    feat = rng.standard_normal((n, 16)).astype(np.float32)
+    return ei, feat
+
+
+# -- raw format ---------------------------------------------------------------
+
+
+def test_raw_dir_roundtrip_and_verify(tmp_path):
+    p = str(tmp_path / "raw")
+    arrays = {
+        "a": np.arange(100, dtype=np.int64),
+        "b": np.random.default_rng(0).random((10, 4)).astype(np.float32),
+    }
+    manifest = save_raw_dir(p, arrays, meta={"k": "v"})
+    assert set(manifest["arrays"]) == {"a", "b"}
+    for mmap in (False, True):
+        loaded, meta = load_raw_dir(p, mmap=mmap)
+        assert meta == {"k": "v"}
+        for name in arrays:
+            np.testing.assert_array_equal(np.asarray(loaded[name]),
+                                          arrays[name])
+    verify_raw_dir(p)  # full CRC sweep passes
+
+
+def test_raw_dir_replaces_existing_atomically(tmp_path):
+    p = str(tmp_path / "raw")
+    save_raw_dir(p, {"a": np.zeros(4)})
+    save_raw_dir(p, {"a": np.ones(4)})
+    loaded, _ = load_raw_dir(p, mmap=False)
+    np.testing.assert_array_equal(np.asarray(loaded["a"]), np.ones(4))
+    # no stray temp/old dirs survive the replace
+    assert sorted(os.listdir(tmp_path)) == ["raw"]
+
+
+def test_torn_raw_dir_detected_and_quarantined(tmp_path):
+    p = str(tmp_path / "raw")
+    save_raw_dir(p, {"a": np.arange(1000, dtype=np.float64)})
+    fpath = os.path.join(p, "a.npy")
+    with open(fpath, "r+b") as fh:
+        fh.truncate(os.path.getsize(fpath) - 16)  # torn write
+    with pytest.raises(CorruptRawDir, match="truncated or torn"):
+        load_raw_dir(p, mmap=True)
+    dest = quarantine_raw_dir(p)
+    assert not os.path.exists(p)
+    assert os.path.basename(dest).startswith("quarantine-")
+
+
+def test_raw_dir_crc_catches_flipped_bytes(tmp_path):
+    p = str(tmp_path / "raw")
+    save_raw_dir(p, {"a": np.arange(1000, dtype=np.float64)})
+    fpath = os.path.join(p, "a.npy")
+    size = os.path.getsize(fpath)
+    with open(fpath, "r+b") as fh:  # same size, different bytes
+        fh.seek(size // 2)
+        fh.write(b"\xff\xfe")
+    load_raw_dir(p, mmap=True)  # structural checks alone can't see it
+    with pytest.raises(CorruptRawDir, match="checksum mismatch"):
+        verify_raw_dir(p)
+
+
+def test_uncommitted_raw_dir_rejected(tmp_path):
+    p = str(tmp_path / "raw")
+    save_raw_dir(p, {"a": np.zeros(4)})
+    os.unlink(os.path.join(p, "COMMIT"))
+    with pytest.raises(CorruptRawDir, match="COMMIT"):
+        load_raw_dir(p)
+
+
+# -- CSRTopo raw persistence --------------------------------------------------
+
+
+def test_topology_raw_save_load_bitwise(tmp_path):
+    ei, _ = _graph()
+    topo = CSRTopo(edge_index=ei)
+    topo.set_edge_weight(np.random.default_rng(1).random(ei.shape[1]))
+    topo.feature_order = np.random.default_rng(2).permutation(
+        topo.node_count
+    )
+    p = str(tmp_path / "topo.raw")
+    topo.save(p, format="raw")
+    for mmap in (False, True):
+        t = CSRTopo.load(p, mmap=mmap)
+        np.testing.assert_array_equal(np.asarray(t.indptr), topo.indptr)
+        np.testing.assert_array_equal(np.asarray(t.indices), topo.indices)
+        np.testing.assert_array_equal(np.asarray(t.eid), topo.eid)
+        # cum_weights persisted, not recomputed: bitwise, not just close
+        np.testing.assert_array_equal(
+            np.asarray(t.cum_weights), topo.cum_weights
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t.feature_order), topo.feature_order
+        )
+        assert t.max_degree == topo.max_degree  # manifest-cached
+        assert t.node_count == topo.node_count
+        assert t.edge_count == topo.edge_count
+    t = CSRTopo.load(p, mmap=True)
+    assert isinstance(t.indices, np.memmap)  # genuinely lazy residency
+
+
+def test_topology_mmap_load_samples_identically(tmp_path):
+    """A sampler driven off the mmap-loaded topology draws the same
+    batches as one on the in-RAM original."""
+    ei, _ = _graph(n=150)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "topo.raw")
+    topo.save(p, format="raw")
+    mtopo = CSRTopo.load(p, mmap=True)
+    seeds = np.random.default_rng(3).integers(0, 150, 32)
+    a = GraphSageSampler(topo, [4, 3], seed_capacity=32, seed=7).sample(seeds)
+    b = GraphSageSampler(mtopo, [4, 3], seed_capacity=32, seed=7).sample(seeds)
+    np.testing.assert_array_equal(np.asarray(a.n_id), np.asarray(b.n_id))
+    for adj_a, adj_b in zip(a.adjs, b.adjs):
+        np.testing.assert_array_equal(
+            np.asarray(adj_a.edge_index), np.asarray(adj_b.edge_index)
+        )
+
+
+def test_topology_mmap_on_npz_raises_clear_error(tmp_path):
+    ei, _ = _graph(n=50)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "topo.npz")
+    topo.save(p)
+    with pytest.raises(ValueError, match='format="raw"'):
+        CSRTopo.load(p, mmap=True)
+
+
+# -- legacy .npz integrity (satellite) ---------------------------------------
+
+
+def test_npz_save_embeds_crc_and_load_verifies(tmp_path):
+    ei, _ = _graph(n=80)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "topo.npz")
+    topo.save(p)
+    with np.load(p) as z:
+        assert "_integrity" in z.files  # CRC record rides the archive
+    t = CSRTopo.load(p)  # verifies silently
+    np.testing.assert_array_equal(t.indices, topo.indices)
+
+
+def test_npz_corrupt_bytes_rejected(tmp_path):
+    """Regression: a byte flip inside a member must fail the load with a
+    clear error naming the artifact — not surface as silently wrong
+    samples three layers later."""
+    ei, _ = _graph(n=80)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "topo.npz")
+    topo.save(p)
+    with open(p, "r+b") as fh:
+        fh.seek(os.path.getsize(p) // 2)
+        fh.write(b"\xff\xfe\xfd\xfc")
+    with pytest.raises(ValueError, match="corrupt"):
+        CSRTopo.load(p)
+
+
+def test_npz_truncated_file_rejected(tmp_path):
+    ei, _ = _graph(n=80)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "topo.npz")
+    topo.save(p)
+    with open(p, "r+b") as fh:
+        fh.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(ValueError, match=p):
+        CSRTopo.load(p)
+
+
+def test_npz_without_integrity_record_still_loads(tmp_path):
+    """Pre-record archives (no ``_integrity`` member) load unverified —
+    backward compatibility with every artifact saved before this PR."""
+    ei, _ = _graph(n=60)
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "legacy.npz")
+    np.savez(p, indptr=topo.indptr, indices=topo.indices)
+    t = CSRTopo.load(p)
+    np.testing.assert_array_equal(t.indices, topo.indices)
+
+
+# -- MmapFeatureStore bitwise parity -----------------------------------------
+
+
+def _ids(n, seed=11):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, n, 64).astype(np.int32)
+    ids[5] = -1  # padding lanes
+    ids[40] = -1
+    return ids
+
+
+@pytest.mark.parametrize("access", ["mmap", "pread"])
+@pytest.mark.parametrize("dtype", [None, "int8"])
+def test_store_gathers_bitwise_equal_feature(tmp_path, access, dtype):
+    """The core differential: every gather from the disk-backed store is
+    bit-for-bit the in-RAM Feature's, at f32 and int8, in both access
+    modes — hot rows, cold rows, padding lanes, repeated ids."""
+    ei, feat = _graph()
+    n = feat.shape[0]
+    topo_a = CSRTopo(edge_index=ei)
+    topo_b = CSRTopo(edge_index=ei)
+    budget = (4 * n + 50 * feat.shape[1]) if dtype == "int8" \
+        else 50 * feat.shape[1] * 4  # 50 hot rows either way
+    feature = Feature(
+        device_cache_size=budget, csr_topo=topo_a, dtype=dtype
+    ).from_cpu_tensor(feat.copy())
+    p = str(tmp_path / "rows")
+    MmapFeatureStore.write(p, feat.copy(), device_cache_size=budget,
+                           csr_topo=topo_b, dtype=dtype)
+    store = MmapFeatureStore(p, access=access, window_rows=16,
+                             cache_windows=8)
+    assert store.hot_rows == feature.hot_rows == 50
+    np.testing.assert_array_equal(
+        np.asarray(topo_a.feature_order), np.asarray(topo_b.feature_order)
+    )
+    for seed in range(3):
+        ids = _ids(n, seed)
+        a = np.asarray(feature[jnp.asarray(ids)])
+        b = np.asarray(store[jnp.asarray(ids)])
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    store.close()
+
+
+def test_store_parity_survives_restage(tmp_path):
+    """Promoting rows into the host cold cache (and spilling them back)
+    must never change a gather's bytes — the cache is a copy, not a
+    variant."""
+    ei, feat = _graph()
+    n = feat.shape[0]
+    topo = CSRTopo(edge_index=ei)
+    feature = Feature(
+        device_cache_size=50 * 16 * 4, csr_topo=topo
+    ).from_cpu_tensor(feat.copy())
+    topo2 = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "rows")
+    MmapFeatureStore.write(p, feat.copy(), device_cache_size=50 * 16 * 4,
+                           csr_topo=topo2)
+    store = MmapFeatureStore(p, window_rows=16, cache_windows=8,
+                             host_cache_rows=24)
+    ids = _ids(n)
+    ref = np.asarray(feature[jnp.asarray(ids)])
+    np.testing.assert_array_equal(np.asarray(store[jnp.asarray(ids)]), ref)
+    assert store.restage(np.arange(24)) == 24
+    np.testing.assert_array_equal(np.asarray(store[jnp.asarray(ids)]), ref)
+    assert store.cold_cache_hits_total > 0  # the cache actually served
+    assert store.restage([]) == 0  # full spill-back
+    np.testing.assert_array_equal(np.asarray(store[jnp.asarray(ids)]), ref)
+    store.close()
+
+
+def test_store_prefetch_overlaps_and_counts_hits(tmp_path):
+    from quiver_tpu.obs.registry import (
+        OOC_PAGE_READS,
+        OOC_READAHEAD_HITS,
+        MetricsRegistry,
+    )
+    from quiver_tpu.obs.timeline import StepTimeline
+
+    ei, feat = _graph()
+    topo = CSRTopo(edge_index=ei)
+    p = str(tmp_path / "rows")
+    MmapFeatureStore.write(p, feat, device_cache_size=50 * 16 * 4,
+                           csr_topo=topo)
+    reg, tl = MetricsRegistry(), StepTimeline()
+    store = MmapFeatureStore(p, window_rows=16, cache_windows=8,
+                             metrics=reg, timeline=tl)
+    ids = jnp.asarray(_ids(feat.shape[0]))
+    assert store.prefetch(ids) > 0  # background reads dispatched
+    store[ids]  # same batch: every window staged or in flight
+    assert store.stager.readahead_hits_total > 0
+    assert int(np.asarray(reg.value(OOC_PAGE_READS))) == \
+        store.stager.page_reads_total
+    assert int(np.asarray(reg.value(OOC_READAHEAD_HITS))) == \
+        store.stager.readahead_hits_total
+    assert "ooc.stage_wait" in tl.summary()
+    store.close()
+
+
+# -- AsyncStager resilience ---------------------------------------------------
+
+
+def _flaky_reader(fail_times):
+    """A window reader that raises ``fail_times`` times per window, then
+    serves the window's index pattern."""
+    failures = {}
+
+    def read(window):
+        failures.setdefault(window, 0)
+        if failures[window] < fail_times:
+            failures[window] += 1
+            raise OSError(f"injected read fault on window {window}")
+        return np.full((4, 2), window, np.int32)
+
+    return read
+
+
+def test_stager_retries_transient_faults(tmp_path):
+    from quiver_tpu.obs.timeline import StepTimeline
+
+    tl = StepTimeline()
+    with AsyncStager(_flaky_reader(2), num_windows=8, window_rows=4,
+                     retries=3, backoff=1e-4, timeline=tl) as st:
+        out = st.fetch(np.array([0, 5, 9]))  # windows 0, 1, 2
+        np.testing.assert_array_equal(out[:, 0], [0, 1, 2])
+        assert st.read_retries_total == 6  # 2 faults x 3 windows
+        assert tl.stats("ooc.retry_wait").count == 6
+
+
+def test_stager_exhausted_retries_surface(tmp_path):
+    with AsyncStager(_flaky_reader(5), num_windows=4, window_rows=4,
+                     retries=1, backoff=0.0) as st:
+        with pytest.raises(OSError, match="injected read fault"):
+            st.fetch(np.array([0]))
+
+
+def test_stager_backoff_jitter_deterministic():
+    from quiver_tpu.obs.timeline import StepTimeline
+
+    def waits(seed):
+        tl = StepTimeline()
+        with AsyncStager(_flaky_reader(3), num_windows=2, window_rows=4,
+                         retries=3, backoff=1e-3, backoff_cap=2e-3,
+                         jitter=0.5, retry_seed=seed, timeline=tl) as st:
+            st.fetch(np.array([0]))
+        stats = tl.stats("ooc.retry_wait")
+        return stats.count, stats.max
+
+    assert waits(5) == waits(5)  # same seed, same jitter stream
+    count, mx = waits(5)
+    assert count == 3
+    assert mx <= 2e-3 * 1.5 + 1e-9  # cap * (1 + jitter)
+
+
+def test_stager_lru_bounds_resident_windows():
+    reads = []
+
+    def read(window):
+        reads.append(window)
+        return np.zeros((4, 1), np.int8)
+
+    with AsyncStager(read, num_windows=100, window_rows=4,
+                     cache_windows=3) as st:
+        for w in range(6):
+            st.fetch(np.array([w * 4]))
+        assert len(st._cache) <= 3
+        st.fetch(np.array([5 * 4]))  # still cached: no new read
+        assert reads.count(5) == 1
+        st.fetch(np.array([0]))  # evicted long ago: re-read
+        assert reads.count(0) == 2
+
+
+# -- 2-device trainer differential -------------------------------------------
+
+
+def test_data_parallel_epoch_bitwise_vs_in_ram(tmp_path):
+    """The flagship differential: a 2-device DataParallelTrainer epoch
+    driven off the disk-backed store produces the SAME loss trajectory,
+    bit for bit, as one off the in-RAM Feature — and steady state adds
+    zero recompiles."""
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DataParallelTrainer
+
+    rng = np.random.default_rng(0)
+    n, classes = 300, 4
+    labels = rng.integers(0, classes, n)
+    feat = (np.eye(classes, dtype=np.float32)[labels] * 2.0
+            + rng.normal(scale=0.8, size=(n, classes)).astype(np.float32))
+    ei = rng.integers(0, n, size=(2, 6 * n)).astype(np.int64)
+
+    budget = 60 * classes * 4  # 20% hot — the cold tier carries real load
+
+    def run(kind):
+        topo = CSRTopo(edge_index=ei)
+        if kind == "ram":
+            feature = Feature(
+                device_cache_size=budget, csr_topo=topo
+            ).from_cpu_tensor(feat.copy())
+        else:
+            p = str(tmp_path / "rows")
+            MmapFeatureStore.write(p, feat.copy(),
+                                   device_cache_size=budget, csr_topo=topo)
+            feature = MmapFeatureStore(p, window_rows=32, cache_windows=8)
+        sampler = GraphSageSampler(topo, [4, 3], seed_capacity=32, seed=5)
+        mesh = make_mesh(data=2, feature=1, devices=jax.devices()[:2])
+        model = GraphSAGE(hidden=16, num_classes=classes, num_layers=2)
+        trainer = DataParallelTrainer(mesh, sampler, feature, model,
+                                      optax.adam(5e-3), local_batch=32)
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        lab = jnp.asarray(labels)
+        losses, cache_sizes = [], []
+        key = jax.random.PRNGKey(1)
+        for epoch in range(2):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss, _ = trainer.train_epoch(
+                params, opt_state, np.arange(n), lab, sub,
+                rng=np.random.default_rng(epoch),
+            )
+            losses.append(float(loss))
+            cache_sizes.append(len(trainer._step_cache))
+        if kind == "disk":
+            assert feature.stager.readahead_hits_total > 0
+            feature.close()
+        return losses, cache_sizes
+
+    ram_losses, _ = run("ram")
+    disk_losses, disk_cache = run("disk")
+    assert ram_losses == disk_losses  # bitwise trajectory
+    assert disk_cache[0] == disk_cache[-1]  # zero steady-state recompiles
+
+
+# -- quiver-ctl over the disk tier -------------------------------------------
+
+
+def test_controller_promotes_measured_hot_disk_rows(tmp_path):
+    import json
+
+    from quiver_tpu.control.controller import CacheController
+    from quiver_tpu.control.freq import FreqSketch
+    from quiver_tpu.obs.export import read_jsonl
+    from quiver_tpu.obs.registry import CTRL_OOC_PROMOTIONS
+
+    ei, feat = _graph()
+    n = feat.shape[0]
+    p = str(tmp_path / "rows")
+    MmapFeatureStore.write(p, feat, device_cache_size=40 * 16 * 4)
+    store = MmapFeatureStore(p, window_rows=16, cache_windows=16,
+                             host_cache_rows=12)
+    log = str(tmp_path / "decisions.jsonl")
+    ctl = CacheController(sketch=FreqSketch(n), decision_log=log)
+    ctl.attach(store)
+    hot_disk = np.arange(100, 112)  # translated rows past hot_rows=40
+    for _ in range(4):
+        ctl.observe_ids(hot_disk)
+    ctl.end_epoch(feature=store)  # branches to maybe_promote
+    np.testing.assert_array_equal(store.staged_ids,
+                                  hot_disk - store.hot_rows)
+    assert ctl.stats()["ooc_promotions"] == 1
+    recs = read_jsonl(log)  # round-trippable metric snapshots
+    assert [r.name for r in recs] == [CTRL_OOC_PROMOTIONS]
+    lines = [json.loads(s) for s in open(log).read().splitlines()]
+    assert lines[-1]["decision"] == "ooc_promote"
+    assert lines[-1]["staged"] == 12
+    # frozen controller: observes but never restages (parity mode)
+    store2 = MmapFeatureStore(p, window_rows=16, cache_windows=16,
+                              host_cache_rows=12)
+    fz = CacheController(sketch=FreqSketch(n), frozen=True).attach(store2)
+    fz.observe_ids(hot_disk)
+    fz.end_epoch(feature=store2)
+    assert store2.staged_ids.size == 0
+    store.close()
+    store2.close()
+
+
+def test_cost_model_disk_term_calibrates(tmp_path):
+    from quiver_tpu.control.cost import CostModel
+    from quiver_tpu.control.freq import FreqSketch, row_heat_histogram
+    from quiver_tpu.obs.timeline import StepTimeline
+
+    ei, feat = _graph()
+    n = feat.shape[0]
+    p = str(tmp_path / "rows")
+    MmapFeatureStore.write(p, feat, device_cache_size=40 * 16 * 4)
+    tl = StepTimeline()
+    store = MmapFeatureStore(p, window_rows=16, cache_windows=8,
+                             timeline=tl)
+    cost = CostModel(local_len=64, num_shards=1)
+    assert not cost.calibrate_disk(tl, store.stager)  # nothing measured
+    store[jnp.asarray(_ids(n))]
+    assert cost.calibrate_disk(tl, store.stager)
+    sk = FreqSketch(n, num_bins=n)  # 1 row per bin: exact masses
+    sk.observe_histogram(np.asarray(
+        row_heat_histogram(jnp.arange(n), None, n, n)
+    ))
+    zero = cost.predict_disk(sk, n, 0)  # everything resident
+    half = cost.predict_disk(sk, store.hot_rows, 0)
+    assert zero["hit_disk"] == 0.0
+    assert half["hit_disk"] == pytest.approx((n - store.hot_rows) / n)
+    assert half["est_disk_s_per_obs"] >= 0.0
+    store.close()
+
+
+# -- chaos-drill building block ----------------------------------------------
+
+
+def test_raw_fallback_to_legacy_npz(tmp_path):
+    """The chaos 'ooc' drill's recovery path, unit-level: a torn raw dir
+    is quarantined and the loader falls back to the legacy .npz of the
+    same topology."""
+    ei, _ = _graph(n=120)
+    topo = CSRTopo(edge_index=ei)
+    raw = str(tmp_path / "topo.raw")
+    npz = str(tmp_path / "topo.npz")
+    topo.save(raw, format="raw")
+    topo.save(npz)
+    shutil.rmtree(os.path.join(raw))
+    os.makedirs(raw)  # empty dir: no COMMIT -> corrupt
+    try:
+        loaded = CSRTopo.load(raw, mmap=True)
+    except CorruptRawDir:
+        quarantine_raw_dir(raw)
+        loaded = CSRTopo.load(npz)
+    np.testing.assert_array_equal(loaded.indices, topo.indices)
+    assert not os.path.exists(raw)  # quarantined aside

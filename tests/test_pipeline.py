@@ -281,3 +281,25 @@ def test_retry_backoff_is_bounded_and_jitter_deterministic(setup):
     assert count_a == count_b == 4
     assert max_a == max_b  # same seed, same jitter draws
     assert max_a <= 2e-3 * 1.5 + 1e-9  # cap * (1 + jitter)
+
+
+def test_queue_depth_gauge_tracks_inflight(setup):
+    """Satellite: prefetch.queue_depth rides the registry — pinned at
+    `depth` while the pipeline keeps up, and drained back to 0 by the
+    end-of-stream flush."""
+    from quiver_tpu.obs.registry import PREFETCH_QUEUE_DEPTH, MetricsRegistry
+
+    topo, _ = setup
+    seeds = _seed_stream(6, 16, topo.node_count)
+    reg = MetricsRegistry()
+    pf = Prefetcher(_fresh_sampler(topo), None, depth=2, metrics=reg)
+
+    observed = []
+    for _ in pf.run(seeds):
+        observed.append(int(np.asarray(reg.value(PREFETCH_QUEUE_DEPTH))))
+    # mid-stream the gauge saw the configured depth at least once, and
+    # never exceeded depth + 1 (the transient before the blocking pop)
+    assert max(observed) >= 2
+    assert max(observed) <= 3
+    # the drain loop pops without refilling: the last yield leaves 0
+    assert observed[-1] == 0
