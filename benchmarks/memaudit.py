@@ -1,0 +1,104 @@
+"""memory-audit lane: the graftmem gate as a scoreboard job.
+
+Runs the ``mem`` rule family (peak-hbm-budget, no-silent-replication,
+vmem-budget, padding-waste) over the full program registry on the
+2-device CPU audit mesh — trace/lower only, no step executes — then
+prints the per-target budget table and emits one headline record:
+
+* ``memaudit-min-headroom`` — the tightest target's remaining budget
+  fraction (``headroom / hbm_budget``). The gate fails (nonzero exit)
+  on ANY graftmem finding, on an unpriced target, or when a target no
+  longer fits its declared budget per ``CostModel.predict_hbm`` — the
+  same surface the controller consults, so the job proves the wiring,
+  not just the table.
+
+``--xla`` additionally compiles every target and joins XLA's
+``memory_analysis()`` peaks as a cross-check column (the only compiling
+path in the auditor; the CI memory-audit job runs it, the default
+scoreboard row skips it for wall-clock).
+
+    python -m benchmarks.memaudit [--xla] [--targets a,b]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from benchmarks import common
+
+
+def _audit_rows(args):
+    from quiver_tpu.control.cost import CostModel
+    from quiver_tpu.tools.audit.mem import format_peak_table, peak_table
+    from quiver_tpu.tools.audit.runner import run_audit
+
+    names = ([n.strip() for n in args.targets.split(",") if n.strip()]
+             if args.targets else None)
+    result = run_audit(select=["mem"], targets=names)
+    for f in result.findings:
+        common.log(f"MEMAUDIT finding: {f.target}: {f.rule}: {f.message}")
+    if result.findings or result.exit_code != 0:
+        raise SystemExit(1)
+
+    rows = peak_table(names, with_xla=args.xla)
+    for line in format_peak_table(rows).splitlines():
+        common.log(line)
+
+    # the controller-facing wiring: the same peaks feed CostModel and
+    # every target must come back as fitting its declared budget
+    model = CostModel(local_len=1, num_shards=1)
+    model.calibrate_hbm({r["target"]: r["peak_bytes"] for r in rows})
+    misfit = [r["target"] for r in rows
+              if r["hbm_budget"] is None
+              or not model.predict_hbm(r["target"],
+                                       r["hbm_budget"])["fits"]]
+    if misfit:
+        common.log(f"MEMAUDIT over budget / unpriced: {misfit}")
+        raise SystemExit(1)
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--xla", action="store_true",
+                   help="compile each target and join XLA "
+                        "memory_analysis() as a cross-check column")
+    p.add_argument("--targets", default=None,
+                   help="comma-separated registry subset (default: all)")
+    p.add_argument("--smoke", action="store_true",
+                   help="accepted for harness parity; the audit is "
+                        "already trace-only and CPU-pinned")
+    args = p.parse_args()
+
+    # the audit mesh is 2 forced host devices — pin BEFORE any jax
+    # backend init (a no-op if the process already chose a backend)
+    from quiver_tpu.tools.audit.cli import _pin_platform
+
+    _pin_platform()
+
+    def body():
+        rows = _audit_rows(args)
+        fracs = {r["target"]: r["headroom_bytes"] / r["hbm_budget"]
+                 for r in rows}
+        tightest = min(fracs, key=fracs.get)
+        extras = {
+            "targets_audited": len(rows),
+            "findings": 0,
+            "tightest_target": tightest,
+            "est_peak_total_bytes": sum(r["peak_bytes"] for r in rows),
+        }
+        if args.xla:
+            ratios = [r["xla_ratio"] for r in rows
+                      if r.get("xla_ratio") is not None]
+            if ratios:
+                extras["xla_ratio_min"] = min(ratios)
+                extras["xla_ratio_max"] = max(ratios)
+        common.emit("memaudit-min-headroom", fracs[tightest], "frac",
+                    None, **extras)
+        return 0
+
+    return common.run_guarded(body, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,9 +18,12 @@ Shape of the run (child process, 2-virtual-device CPU mesh):
    mmap of the rows file would count its full size against RLIMIT_AS —
    the pread path keeps address space O(window cache), which is the
    point);
-3. warm up one DataParallelTrainer epoch (compiles the step), read
-   ``VmSize`` from /proc/self/status, then ``setrlimit(RLIMIT_AS,
-   VmSize + budget)``;
+3. warm up one DataParallelTrainer epoch (compiles the step), trace the
+   SAME cached step on a probe batch group and gate graftmem's static
+   peak estimate against the address budget about to be enforced (via
+   ``CostModel.calibrate_hbm``/``predict_hbm`` — the drill fails by
+   prediction before it can fail by rlimit kill), read ``VmSize`` from
+   /proc/self/status, then ``setrlimit(RLIMIT_AS, VmSize + budget)``;
 4. run the measured epochs under the limit and require: the epoch
    completes, ``ooc.readahead_hits > 0`` (the stager's window
    amortization did real work), and ``len(trainer._step_cache)`` is
@@ -175,7 +178,46 @@ def _child(args) -> int:
     )
     warm_s = time.time() - t0
     cache_warm = len(trainer._step_cache)
+
+    # graftmem gate: statically predict the step program's peak bytes
+    # from the SAME cached jit the measured epochs will run (trace-only
+    # — nothing executes) and require it to fit the address budget about
+    # to be enforced, through the controller-facing CostModel surface.
+    # A step that cannot fit fails here, by prediction, instead of an
+    # opaque MemoryError mid-epoch under the rlimit.
+    from types import SimpleNamespace
+
+    from quiver_tpu.control.cost import CostModel
+    from quiver_tpu.tools.audit import mem as graftmem
+
+    probe = [
+        SimpleNamespace(out=out_, x=store[out_.n_id])
+        for out_ in (sampler.sample(np.asarray(blk))
+                     for blk in trainer.seed_blocks(
+                         idx[:trainer.global_batch]))
+    ]
+    caps, fanouts, xs, n_id, eis, bsz = trainer._stack(probe)
+    step = trainer._compiled_step(caps, fanouts, xs.shape[-1])
+    traced = step.trace(params, opt, xs, eis, n_id, bsz, lab,
+                        jax.random.PRNGKey(9))
+    est = graftmem.estimate_peak(traced.jaxpr)
+    # est is per-device; every virtual device lives in THIS process, so
+    # the address-space gate sees the whole mesh's residency
+    predicted = est.peak_bytes * int(mesh.devices.size)
+    del probe, xs, n_id, eis, bsz, traced
+
     vm = _vm_size_bytes()
+    model = CostModel(local_len=args.local_batch, num_shards=1)
+    model.calibrate_hbm({"ooc_step": predicted})
+    fit = model.predict_hbm("ooc_step", budget_bytes=vm + budget)
+    common.log(f"[child] graftmem: step peak {est.peak_bytes / 1e6:.1f} "
+               f"MB/device ({predicted / 1e6:.1f} MB mesh-wide) vs "
+               f"{(vm + budget) / 1e6:.0f} MB address budget")
+    assert fit["fits"], (
+        f"static step peak {predicted} B cannot fit the enforced "
+        f"RLIMIT_AS {vm + budget} B (headroom {fit['headroom_bytes']})"
+    )
+
     common.log(f"[child] warmup epoch {warm_s:.1f}s, VmSize "
                f"{vm / 1e6:.0f} MB; clamping RLIMIT_AS to +"
                f"{budget / 1e6:.0f} MB")
@@ -217,6 +259,7 @@ def _child(args) -> int:
         "stage_wait_s": round(float(wait.total), 4) if wait else 0.0,
         "recompiles_steady": 0,
         "hot_rows": int(store.hot_rows),
+        "predicted_peak_bytes": int(predicted),
     }), flush=True)
     return 0
 
@@ -289,6 +332,7 @@ def main():
             recompiles_steady=rec["recompiles_steady"],
             hot_rows=rec["hot_rows"],
             steps=rec["steps"],
+            predicted_peak_bytes=rec.get("predicted_peak_bytes"),
         )
         common.log(
             f"OOC drill OK: {rec['graph_over_budget']}x graph-over-budget, "

@@ -173,6 +173,11 @@ JOBS = [
      "per-edge-type lanes-per-hop model + per-(hop, edge type) "
      "sample_overflow; bit-identical to the replicated hetero sampler "
      "(tests/test_dist_hetero.py)"),
+    ("memaudit", "benchmarks.memaudit", [],
+     "graftmem gate: the mem rule family over the full program registry "
+     "on the 2-device CPU audit mesh (trace-only, burns no chip time) + "
+     "the per-target budget table; headline = tightest headroom "
+     "fraction, fails on any finding or over-budget target"),
 ]
 
 TIMEOUT = float(os.environ.get("QUIVER_BENCH_TIMEOUT", 1800))

@@ -112,6 +112,8 @@ class CostModel:
         self._t_window = 0.0    # exposed seconds per disk window read
         self._window_rows = 1
         self.disk_calibrated = False
+        self._hbm_peaks = {}    # target name -> static per-device peak bytes
+        self.hbm_calibrated = False
 
     def calibrate(self, timeline, stage: str = "step",
                   alpha: float | None = None, h0: float = 0.0) -> bool:
@@ -194,4 +196,45 @@ class CostModel:
             out["est_disk_s_per_obs"] = (
                 self._t_window * miss / total / self._window_rows
             )
+        return out
+
+    # -- static HBM peaks (graftmem) -----------------------------------------
+
+    def calibrate_hbm(self, peaks: dict) -> bool:
+        """Anchor the per-target static peak-HBM surface from graftmem's
+        liveness-walk estimates (``{target_name: peak_bytes}`` — e.g. the
+        ``peak_bytes`` column of :func:`quiver_tpu.tools.audit.mem
+        .peak_table`). Unlike the timing coefficients these are not
+        measured: they are PROVEN upper-shape bounds over the lowered IR,
+        so a candidate the controller is ranking can be rejected for not
+        fitting before anything executes. Returns False (model
+        unchanged) on an empty mapping."""
+        clean = {str(k): int(v) for k, v in dict(peaks).items()
+                 if int(v) >= 0}
+        if not clean:
+            return False
+        self._hbm_peaks.update(clean)
+        self.hbm_calibrated = True
+        return True
+
+    def predict_hbm(self, target: str, budget_bytes: int | None = None
+                    ) -> dict:
+        """Predicted per-device peak bytes for ``target`` against an
+        optional budget. ``known`` is False for a target the model has
+        not been calibrated with (``fits`` stays None rather than
+        guessing); with a budget, ``headroom_bytes`` < 0 means the
+        static walk already proves the candidate cannot fit."""
+        peak = self._hbm_peaks.get(str(target))
+        out = {
+            "target": str(target),
+            "known": peak is not None,
+            "peak_bytes": peak,
+            "budget_bytes": None if budget_bytes is None
+            else int(budget_bytes),
+            "headroom_bytes": None,
+            "fits": None,
+        }
+        if peak is not None and budget_bytes is not None:
+            out["headroom_bytes"] = int(budget_bytes) - peak
+            out["fits"] = peak <= int(budget_bytes)
         return out

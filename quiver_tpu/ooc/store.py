@@ -331,6 +331,40 @@ class MmapFeatureStore(KernelChoice):
                 cold_gather,
             )
 
+    def trace_lookup(self, batch: int):
+        """AOT-trace the device-side tier merge one staged batch runs —
+        the SAME ``tiered_lookup`` + dequant wrapping as
+        :meth:`__getitem__`, with the host-assembled cold block as a
+        program *operand* (host staging cannot be traced). No disk
+        I/O, no execution: this is the graftmem audit surface for the
+        out-of-core path (``mmap_tiered_gather``), so the merge's
+        per-device bytes are provable without paging the table in."""
+        import jax
+
+        operands = [jax.ShapeDtypeStruct((int(batch),), jnp.int32)]
+        if self._cold_rows > 0:
+            operands.append(jax.ShapeDtypeStruct(
+                (int(batch), self.shape[1]), self.dtype))
+
+        def merged(n_id, *staged):
+            cold_gather = None
+            if staged:
+                block = staged[0]
+                cold_gather = lambda ids: block  # noqa: E731
+            hot_gather = (
+                None if self.hot is None
+                else _hot_gather_fn(self.hot, self.kernel)
+            )
+            _, hot_gather, cold_gather = wrap_dequant_gathers(
+                self.scale, self.hot_rows, hot_gather, cold_gather
+            )
+            return tiered_lookup(
+                n_id, self.feature_order, self.hot_rows, hot_gather,
+                cold_gather,
+            )
+
+        return jax.jit(merged).trace(*operands)
+
     def prefetch(self, n_id) -> int:
         """Dispatch background disk reads for a FUTURE batch's cold rows
         (bounded; returns reads issued). The overlap seam: call with

@@ -20,10 +20,26 @@ one rule family per established discipline:
 * ``comm-budget`` — lowered epoch all_to_all lanes ==
   ``control/cost.routed_lanes_per_hop`` exactly (PR 6/8).
 
+The graftmem family (``tools/audit/mem.py``) extends the same registry
+from comm invariants to memory/layout invariants — still proven on the
+lowered IR, never by executing:
+
+* ``peak-hbm-budget`` — donation-aware liveness walk computes each
+  target's per-device peak bytes under the audit-mesh shardings and
+  gates it against the registry-declared ``hbm_budget``; an unpriced
+  target is itself a finding.
+* ``no-silent-replication`` — an intermediate that degenerates to full
+  replication along the feature axis (the all_gather cliff the routed
+  path exists to avoid), attributed to its producing op.
+* ``vmem-budget`` — static VMEM/scratch accounting of every Pallas
+  kernel's resident blocks vs the ~16 MiB per-core budget.
+* ``padding-waste`` — lanes-vs-payload ratio per routed all_to_all;
+  over-provisioned bucket caps ship padding bought with real HBM.
+
 CLI: ``python -m quiver_tpu.tools.audit`` (``--json``, ``--sarif PATH``,
 ``--select``/``--ignore`` rules or families, ``--targets``,
-``--changed BASE``, ``--list-rules``, ``--list-targets``; exit 0 clean /
-1 findings / 2 usage). Waivers are registry-side: a ``Target``
+``--changed BASE``, ``--list-rules``, ``--list-targets``,
+``--mem-table`` [``--mem-xla``]; exit 0 clean / 1 findings / 2 usage). Waivers are registry-side: a ``Target``
 declaration carries its reasoned exemptions, since an IR finding has no
 source line for an inline comment.
 
@@ -34,6 +50,7 @@ import jax lazily when a target is traced.
 
 from .audit_targets import REGISTRY, Built, Target, build, build_from
 from .cli import main
+from .mem import estimate_peak, peak_table
 from .rules import FAMILIES, RULES, family_of, rule_docs
 from .runner import AuditResult, changed_files, run_audit, select_targets
 
@@ -47,8 +64,10 @@ __all__ = [
     "build",
     "build_from",
     "changed_files",
+    "estimate_peak",
     "family_of",
     "main",
+    "peak_table",
     "rule_docs",
     "run_audit",
     "select_targets",

@@ -207,7 +207,9 @@ _EXPECTED_METRIC_REDUCTIONS = 2
     "routed_gather",
     "capped-bucket routed feature gather with the forced psum fallback "
     "cond (cap < per-shard demand)",
-    sources=("quiver_tpu/feature/shard.py", "quiver_tpu/parallel/routing.py"),
+    sources=("quiver_tpu/feature/shard.py", "quiver_tpu/parallel/routing.py",
+             "quiver_tpu/parallel/mesh.py"),
+    meta={"hbm_budget": 2048},
 )
 def _routed_gather():
     import jax
@@ -241,7 +243,7 @@ def _routed_gather():
     "lookup with int8 codes riding the routed all_to_all",
     sources=("quiver_tpu/feature/shard.py", "quiver_tpu/feature/feature.py",
              "quiver_tpu/parallel/trainer.py"),
-    meta={"int8_path": True},
+    meta={"int8_path": True, "hbm_budget": 40 * 1024},
 )
 def _tiered_lookup_int8():
     return _trace_step(*_tiny_trainer(int8=True, collect_metrics=False))
@@ -251,7 +253,9 @@ def _tiered_lookup_int8():
     "sample_hop",
     "topo-sharded multilayer sample program (dist_sample_layer hops in "
     "shard_map, owner-routed frontiers)",
-    sources=("quiver_tpu/sampling/dist.py", "quiver_tpu/core/topology.py"),
+    sources=("quiver_tpu/sampling/dist.py", "quiver_tpu/sampling/sampler.py",
+             "quiver_tpu/core/topology.py"),
+    meta={"hbm_budget": 32 * 1024},
 )
 def _sample_hop():
     import jax
@@ -280,7 +284,7 @@ def _sample_hop():
     "— the comm-budget anchor at the tight cap",
     sources=("quiver_tpu/parallel/trainer.py", "quiver_tpu/control/cost.py",
              "quiver_tpu/feature/shard.py"),
-    meta={"comm": dict(_EPOCH_COMM, alpha=1.0)},
+    meta={"comm": dict(_EPOCH_COMM, alpha=1.0), "hbm_budget": 64 * 1024},
 )
 def _epoch_alpha1():
     return _trace_epoch(*_tiny_trainer(routed_alpha=1.0))
@@ -292,7 +296,7 @@ def _epoch_alpha1():
     "lanes double against the same analytic model",
     sources=("quiver_tpu/parallel/trainer.py", "quiver_tpu/control/cost.py",
              "quiver_tpu/feature/shard.py"),
-    meta={"comm": dict(_EPOCH_COMM, alpha=2.0)},
+    meta={"comm": dict(_EPOCH_COMM, alpha=2.0), "hbm_budget": 64 * 1024},
 )
 def _epoch_alpha2():
     return _trace_epoch(*_tiny_trainer(routed_alpha=2.0))
@@ -304,6 +308,7 @@ def _epoch_alpha2():
     "same invariants as the serial scan",
     sources=("quiver_tpu/parallel/trainer.py",
              "quiver_tpu/parallel/pipeline.py"),
+    meta={"hbm_budget": 128 * 1024},
 )
 def _epoch_pipelined():
     return _trace_epoch(*_tiny_trainer(pipeline_depth=1), steps=2)
@@ -315,7 +320,7 @@ def _epoch_pipelined():
     "actually be donated (aliased or buffer-donor) with zero "
     "unusable-donation warnings",
     sources=("quiver_tpu/parallel/trainer.py",),
-    meta={"donation": "claimed"},
+    meta={"donation": "claimed", "hbm_budget": 64 * 1024},
 )
 def _epoch_donating():
     import jax
@@ -331,8 +336,9 @@ def _epoch_donating():
     "serve_forward",
     "serving-ladder forward program (largest bucket): AOT ladder rung the "
     "steady-state replay contract is staked on",
-    sources=("quiver_tpu/serving/ladder.py", "quiver_tpu/models/sage.py"),
-    meta={"donation": "none"},
+    sources=("quiver_tpu/serving/ladder.py", "quiver_tpu/models/sage.py",
+             "quiver_tpu/models/layers.py", "quiver_tpu/parallel/train.py"),
+    meta={"donation": "none", "hbm_budget": 24 * 1024},
 )
 def _serve_forward():
     lad = _ladder()
@@ -343,7 +349,7 @@ def _serve_forward():
     "serve_sample",
     "serving-ladder per-bucket sample program (scan over lane samples)",
     sources=("quiver_tpu/serving/ladder.py", "quiver_tpu/ops/sample.py"),
-    meta={"donation": "none"},
+    meta={"donation": "none", "hbm_budget": 24 * 1024},
 )
 def _serve_sample():
     lad = _ladder()
@@ -355,6 +361,7 @@ def _serve_sample():
     "trainer step with collect_metrics=True — the telemetry-carrying "
     "half of the metrics-strip differential",
     sources=("quiver_tpu/parallel/trainer.py", "quiver_tpu/obs/registry.py"),
+    meta={"hbm_budget": 64 * 1024},
 )
 def _metrics_on():
     return _trace_step(*_tiny_trainer(collect_metrics=True))
@@ -366,7 +373,8 @@ def _metrics_on():
     "minus exactly the declared metric reductions",
     sources=("quiver_tpu/parallel/trainer.py", "quiver_tpu/obs/registry.py"),
     meta={"metrics_pair": "metrics_on",
-          "expected_metric_reductions": _EXPECTED_METRIC_REDUCTIONS},
+          "expected_metric_reductions": _EXPECTED_METRIC_REDUCTIONS,
+          "hbm_budget": 64 * 1024},
 )
 def _metrics_off():
     return _trace_step(*_tiny_trainer(collect_metrics=False))
@@ -381,7 +389,9 @@ def _metrics_off():
     "(the QUIVER_{SAMPLE,GATHER}_KERNEL=pallas election paths)",
     sources=("quiver_tpu/ops/pallas/fused.py",
              "quiver_tpu/ops/pallas/sample.py",
-             "quiver_tpu/ops/pallas/gather.py"),
+             "quiver_tpu/ops/pallas/gather.py",
+             "quiver_tpu/ops/election.py"),
+    meta={"hbm_budget": 64 * 1024},
     # the CSR topology rides the closure as trace constants — bounded at
     # ~10KB here, and the production path passes topology as operands
     waivers={"constant-bloat": "fixture topology is closure-captured by "
@@ -442,3 +452,105 @@ def _ladder():
     lad.bind_params(params)
     _SHARED["ladder"] = lad
     return lad
+
+
+@_register(
+    "serve_fleet_forward",
+    "fleet replica serve-ladder forward, warm-from-AOT variant: the "
+    "program a second replica REPLAYS after deserializing the first "
+    "replica's published executables (PR 17's zero-compile join) — the "
+    "traced forward must carry the same invariants whether it was "
+    "compiled locally or loaded from the shared cache",
+    sources=("quiver_tpu/serving/fleet.py", "quiver_tpu/serving/aot.py",
+             "quiver_tpu/serving/server.py", "quiver_tpu/serving/ladder.py"),
+    meta={"donation": "none", "hbm_budget": 24 * 1024},
+)
+def _serve_fleet_forward():
+    fleet = _fleet()
+    # the warm joiner, not the cache-populating first replica
+    return fleet.servers[-1]._ladder.trace_forward(4)
+
+
+@_register(
+    "mmap_tiered_gather",
+    "MmapFeatureStore device-side tier merge (quiver-ooc): the traced "
+    "tiered_lookup + dequant wrapping one staged batch runs, with the "
+    "host-assembled cold block as a program operand — the out-of-core "
+    "path's only on-device program",
+    sources=("quiver_tpu/ooc/store.py", "quiver_tpu/ooc/format.py",
+             "quiver_tpu/ooc/stager.py", "quiver_tpu/feature/feature.py"),
+    meta={"hbm_budget": 16 * 1024},
+)
+def _mmap_tiered_gather():
+    return _mmap_store().trace_lookup(16)
+
+
+def _fleet():
+    """A two-replica ServingFleet over a throwaway disk AOT cache: the
+    first replica compiles+publishes (bucket 4 only, to bound build
+    cost), the second joins warm. Construction compiles — never
+    executes — which keeps the registry's trace-only discipline."""
+    if "fleet" in _SHARED:
+        return _SHARED["fleet"]
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.topology import CSRTopo
+    from ...feature.feature import Feature
+    from ...models.sage import GraphSAGE
+    from ...parallel.train import empty_adjs, init_model
+    from ...sampling.sampler import GraphSageSampler
+    from ...serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(3)
+    n, e = 160, 900
+    ei = np.stack([rng.integers(0, n, e), rng.integers(0, n, e)])
+    topo = CSRTopo(edge_index=ei)
+    feat = Feature(device_cache_size="1G").from_cpu_tensor(
+        rng.normal(size=(n, 12)).astype(np.float32))
+    sampler = GraphSageSampler(topo, [4, 3], seed=1, seed_capacity=4)
+    model = GraphSAGE(hidden=16, num_classes=5, num_layers=2)
+    adjs = empty_adjs([4, 3], batch=4, node_count=n)
+    params = init_model(
+        model, jax.random.PRNGKey(0),
+        jnp.zeros((adjs[0].size[0], 12), jnp.float32), adjs,
+    )
+    cache_dir = tempfile.mkdtemp(prefix="graftmem-aot-")
+    fleet = ServingFleet(
+        sampler, model, params, feat, replicas=1, aot_cache=cache_dir,
+        seed=7, warm=True, max_batch=4, buckets=(4,),
+    )
+    fleet.add_replica(warm=True)
+    # record the join ledger so tests can assert the audited program
+    # really is the warm-from-AOT variant (zero compiles on join)
+    _REGISTRY["serve_fleet_forward"].meta["warm_join"] = dict(
+        loaded=int(fleet.cold_starts[-1]["loaded"]),
+        compiled=int(fleet.cold_starts[-1]["compiled"]),
+    )
+    _SHARED["fleet"] = fleet
+    return fleet
+
+
+def _mmap_store():
+    """A tiny on-disk raw feature dir + reopened MmapFeatureStore with
+    live hot AND cold tiers (device_cache_size splits the 64 rows)."""
+    if "mmap_store" in _SHARED:
+        return _SHARED["mmap_store"]
+    import tempfile
+
+    from ...core.topology import CSRTopo
+    from ...ooc.store import MmapFeatureStore
+
+    rng = np.random.default_rng(5)
+    n, f = 64, 8
+    ei = np.stack([rng.integers(0, n, 400), rng.integers(0, n, 400)])
+    topo = CSRTopo(edge_index=ei)
+    tensor = rng.normal(size=(n, f)).astype(np.float32)
+    path = tempfile.mkdtemp(prefix="graftmem-ooc-")
+    MmapFeatureStore.write(path, tensor,
+                           device_cache_size=16 * f * 4, csr_topo=topo)
+    store = MmapFeatureStore(path, access="mmap", window_rows=16)
+    _SHARED["mmap_store"] = store
+    return store

@@ -2,7 +2,8 @@
 
     python -m quiver_tpu.tools.audit [--json] [--sarif PATH] \
         [--select rules] [--ignore rules] [--targets names] \
-        [--changed BASE] [--list-rules] [--list-targets]
+        [--changed BASE] [--list-rules] [--list-targets] \
+        [--mem-table [--mem-xla]]
 
 Exit codes (stable, for CI — same contract as graftlint):
   0 — clean (waived findings are fine)
@@ -52,8 +53,10 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="python -m quiver_tpu.tools.audit",
         description="graftaudit — jaxpr/StableHLO-level program auditor: "
                     "collective parity, metric stripping, donation, dtype "
-                    "discipline, constant bloat and the comm budget, "
-                    "proven on lowered IR without executing a step",
+                    "discipline, constant bloat, the comm budget and the "
+                    "graftmem memory family (peak-HBM, replication, VMEM, "
+                    "padding), proven on lowered IR without executing a "
+                    "step",
     )
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
@@ -76,6 +79,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         "and exit")
     p.add_argument("--list-targets", action="store_true",
                    help="print the audited program registry and exit")
+    p.add_argument("--mem-table", action="store_true",
+                   help="print the graftmem per-target budget table "
+                        "(est peak / args / out / budget / headroom) "
+                        "and exit")
+    p.add_argument("--mem-xla", action="store_true",
+                   help="with --mem-table: compile each target and join "
+                        "XLA memory_analysis() peaks as a cross-check "
+                        "column (the only compiling audit path)")
     return p
 
 
@@ -103,6 +114,17 @@ def main(argv=None) -> int:
                 print(f"    waiver[{rule}]: {reason}")
         return 0
     split = (lambda s: [r.strip() for r in s.split(",") if r.strip()])
+    if args.mem_table:
+        from .mem import format_peak_table, peak_table
+
+        names = split(args.targets) if args.targets else None
+        rows = peak_table(names, with_xla=args.mem_xla)
+        print(format_peak_table(rows))
+        over = [r for r in rows
+                if r["hbm_budget"] is None
+                or (r["headroom_bytes"] is not None
+                    and r["headroom_bytes"] < 0)]
+        return 1 if over else 0
     try:
         changed = None
         if args.changed is not None:

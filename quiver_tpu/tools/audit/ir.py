@@ -231,14 +231,20 @@ _MAIN_RE = re.compile(r"func\.func\s+(?:public\s+)?@main\((.*?)\)\s*->",
 
 def _split_top_level(s: str) -> list:
     """Split an MLIR argument list on top-level commas (respects nesting
-    of ``<>``, ``{}``, ``()`` and ``[]`` inside type/attr expressions)."""
-    parts, depth, cur = [], 0, []
+    of ``<>``, ``{}``, ``()`` and ``[]`` inside type/attr expressions,
+    and ignores brackets inside string attrs — a sharding literal like
+    ``"{devices=[2,1]<=[2]}"`` carries an unbalanced ``<`` that would
+    otherwise swallow every following comma and merge arguments)."""
+    parts, depth, cur, in_str = [], 0, [], False
     for ch in s:
-        if ch in "<{([":
-            depth += 1
-        elif ch in ">})]":
-            depth -= 1
-        if ch == "," and depth == 0:
+        if ch == '"':
+            in_str = not in_str
+        elif not in_str:
+            if ch in "<{([":
+                depth += 1
+            elif ch in ">})]":
+                depth -= 1
+        if ch == "," and depth == 0 and not in_str:
             parts.append("".join(cur).strip())
             cur = []
         else:
@@ -249,22 +255,30 @@ def _split_top_level(s: str) -> list:
     return parts
 
 
+_ALIAS_IDX_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
 def main_arg_attrs(mlir_text: str) -> list:
     """Per-argument donation facts of ``@main``: a list (one dict per
-    flattened argument, in order) of ``{"aliased": bool, "donor": bool}``.
+    flattened argument, in order) of ``{"aliased": bool, "donor": bool,
+    "alias_output": int | None}``.
     ``aliased`` = jax wired the input to an output buffer at lowering
-    (``tf.aliasing_output``); ``donor`` = donated with the buffer pairing
-    deferred to XLA (``jax.buffer_donor``). Either attr counts as the
-    donation being real; a donated arg with NEITHER never lowered at all
-    (unusable donations surface only as build warnings)."""
+    (``tf.aliasing_output``), with ``alias_output`` the flattened result
+    index it writes into — the operand↔result *pairing*, not just the
+    count; ``donor`` = donated with the buffer pairing deferred to XLA
+    (``jax.buffer_donor``, ``alias_output`` None). Either attr counts as
+    the donation being real; a donated arg with NEITHER never lowered at
+    all (unusable donations surface only as build warnings)."""
     m = _MAIN_RE.search(mlir_text)
     if m is None:
         return []
     out = []
     for arg in _split_top_level(m.group(1)):
+        am = _ALIAS_IDX_RE.search(arg)
         out.append({
-            "aliased": "tf.aliasing_output" in arg,
+            "aliased": am is not None,
             "donor": "jax.buffer_donor" in arg,
+            "alias_output": None if am is None else int(am.group(1)),
         })
     return out
 

@@ -21,6 +21,11 @@ Rule families and the PR whose discipline they machine-check:
   (an HBM + recompile hazard for AOT ladders).
 * comm (comm-budget) — PR 6/8: the lowered epoch body's all_to_all lanes
   equal ``control/cost.routed_lanes_per_hop`` exactly.
+* graftmem (hbm / replication / vmem / padding) — this PR: per-device
+  memory & layout invariants from :mod:`.mem` — liveness-walk peak vs
+  ``meta["hbm_budget"]``, feature-axis replication cliffs, Pallas VMEM
+  block budgets, and padded-lane waste per routed all_to_all. Select all
+  four at once with ``--select mem``.
 """
 
 from __future__ import annotations
@@ -28,7 +33,7 @@ from __future__ import annotations
 from collections import Counter
 
 from ..lint.rules import Finding
-from . import ir
+from . import ir, mem
 
 __all__ = ["FAMILIES", "RULES", "family_of", "rule_docs"]
 
@@ -243,6 +248,98 @@ def check_comm_budget(target, built, builds) -> list:
     return out
 
 
+def check_peak_hbm_budget(target, built, builds) -> list:
+    """Every target's liveness-walk peak (per-device bytes under the
+    audit mesh's shardings, donation-discounted — see
+    :func:`~quiver_tpu.tools.audit.mem.estimate_peak`) fits its declared
+    ``meta["hbm_budget"]``; a target declaring NO budget is itself a
+    finding, so new programs enter the registry priced. Regressions fail
+    this audit on the lowered IR, not a TPU run."""
+    est = mem.estimate_peak(built.jaxpr, built.mlir)
+    budget = built.meta.get("hbm_budget")
+    if budget is None:
+        return [_finding(
+            "peak-hbm-budget", target,
+            f"no meta['hbm_budget'] declared (estimated per-device peak "
+            f"is {est.peak_bytes} bytes) — every registry program must "
+            "enter priced",
+        )]
+    if est.peak_bytes > int(budget):
+        return [_finding(
+            "peak-hbm-budget", target,
+            f"estimated per-device peak {est.peak_bytes} bytes exceeds "
+            f"the declared hbm_budget of {int(budget)} (args="
+            f"{est.arg_bytes}, out={est.out_bytes}, donation discount="
+            f"{est.aliased_bytes})",
+        )]
+    return []
+
+
+def check_no_silent_replication(target, built, builds) -> list:
+    """No intermediate silently degenerates to full replication along
+    the feature axis: a gather-family collective over ``feature`` whose
+    result crosses ``meta["replication_bytes_limit"]`` (default
+    :data:`~quiver_tpu.tools.audit.mem.REPLICATION_BYTES_LIMIT`) is the
+    exact op that makes a "sharded" operand cost F× memory per device.
+    The finding names the producer of the gathered operand (backward
+    slice) so the fix starts at the source op, not the symptom."""
+    limit = int(built.meta.get("replication_bytes_limit",
+                               mem.REPLICATION_BYTES_LIMIT))
+    out = []
+    for rep in mem.feature_replications(built.jaxpr, limit=limit):
+        loc = "/".join(rep["path"]) or "top"
+        out.append(_finding(
+            "no-silent-replication", target,
+            f"{rep['prim']} over '{rep['axis']}' at {loc} replicates "
+            f"{rep['dtype']}{list(rep['shape'])} ({rep['bytes']} bytes "
+            f">= {limit}) onto every device — gathered operand produced "
+            f"by {rep['producer']}",
+        ))
+    return out
+
+
+def check_vmem_budget(target, built, builds) -> list:
+    """Every Pallas kernel's simultaneously-resident VMEM blocks +
+    scratch (window lanes, gather tiles — the memory-refs its body
+    binds) fit the per-core budget (``meta["vmem_budget"]``, default
+    :data:`~quiver_tpu.tools.audit.mem.DEFAULT_VMEM_BUDGET` ≈ one TPU
+    core's VMEM). Machine-checks the megakernel's window sizing instead
+    of comment-checking it."""
+    budget = int(built.meta.get("vmem_budget", mem.DEFAULT_VMEM_BUDGET))
+    out = []
+    for u in mem.vmem_usages(built.jaxpr):
+        if u.vmem_bytes + u.smem_bytes > budget:
+            out.append(_finding(
+                "vmem-budget", target,
+                f"{u} exceeds the per-core VMEM budget of {budget} bytes",
+            ))
+    return out
+
+
+def check_padding_waste(target, built, builds) -> list:
+    """Padded all_to_all lanes are bought with real HBM and wire bytes:
+    on targets declaring a comm model, each routed hop's waste fraction
+    (1 - payload/lanes, payload = ``local_len * (1 - h0)``) must stay
+    under ``meta["padding_waste_limit"]`` (default
+    :data:`~quiver_tpu.tools.audit.mem.PADDING_WASTE_LIMIT`; the alpha=2
+    routed budget sits at 0.5 by construction). Catches runaway caps
+    that comm-budget's exact-lane check would only see after the
+    registry declaration itself drifted."""
+    limit = float(built.meta.get("padding_waste_limit",
+                                 mem.PADDING_WASTE_LIMIT))
+    out = []
+    for w in mem.padding_waste(built):
+        if w["waste"] > limit:
+            out.append(_finding(
+                "padding-waste", target,
+                f"{w['collective']} ships {w['lanes']} lanes for "
+                f"{w['payload_lanes']:g} payload lanes — waste "
+                f"{w['waste']:.3f} > {limit:g} (cap {w['cap']} is "
+                "over-provisioned for the declared route)",
+            ))
+    return out
+
+
 RULES = {
     "collective-parity": check_collective_parity,
     "metrics-strip": check_metrics_strip,
@@ -250,6 +347,10 @@ RULES = {
     "dtype-discipline": check_dtype_discipline,
     "constant-bloat": check_constant_bloat,
     "comm-budget": check_comm_budget,
+    "peak-hbm-budget": check_peak_hbm_budget,
+    "no-silent-replication": check_no_silent_replication,
+    "vmem-budget": check_vmem_budget,
+    "padding-waste": check_padding_waste,
 }
 
 FAMILIES = {
@@ -259,6 +360,14 @@ FAMILIES = {
     "dtype": ("dtype-discipline",),
     "constants": ("constant-bloat",),
     "comm": ("comm-budget",),
+    "hbm": ("peak-hbm-budget",),
+    "replication": ("no-silent-replication",),
+    "vmem": ("vmem-budget",),
+    "padding": ("padding-waste",),
+    # umbrella: the whole graftmem family behind one --select handle.
+    # Keep LAST so family_of resolves each rule to its specific family.
+    "mem": ("peak-hbm-budget", "no-silent-replication", "vmem-budget",
+            "padding-waste"),
 }
 
 META_RULES = ("audit-error",)

@@ -82,6 +82,8 @@ SECTIONS = [
      "graftlint static analyzer (trace-safety rules)"),
     ("quiver_tpu.tools.audit",
      "graftaudit — jaxpr/HLO program auditor (lowered-IR invariants)"),
+    ("quiver_tpu.tools.audit.mem",
+     "graftmem — static per-device memory & layout accounting"),
     ("quiver_tpu.tools.sarif",
      "Shared SARIF plumbing (lint + audit, merged CI artifact)"),
 ]

@@ -59,6 +59,10 @@ ORDER = [
     # is trace-only, so it proves the compiled-program invariants in
     # seconds before any chip time executes a step on top of them
     ("audit", 300),
+    # graftmem right after graftaudit: the memory/budget gate is also
+    # trace-only (CPU audit mesh) and its headline row — the tightest
+    # hbm_budget headroom fraction — lands before any chip time burns
+    ("memaudit", 420),
     # chaos drills right after lint: resilience regressions (guard,
     # retry, checkpoint/resume bit-parity, elastic resize, corrupt-
     # checkpoint fallback, cold-tier outage) fail the session early,

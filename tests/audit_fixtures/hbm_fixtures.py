@@ -1,0 +1,30 @@
+"""peak-hbm-budget fixtures: the same matmul program over-budget
+(positive), entering the registry unpriced (positive — a missing
+``hbm_budget`` is itself the finding), and honestly priced (negative)."""
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu.tools.audit.audit_targets import Target
+
+
+def _program():
+    def body(x):
+        y = jnp.tanh(x @ x.T)  # (64, 64) f32 intermediate live with x
+        return y.sum(axis=1)
+
+    return jax.jit(body).trace(
+        jax.ShapeDtypeStruct((64, 32), jnp.float32))
+
+
+def targets():
+    src = ("tests/audit_fixtures/hbm_fixtures.py",)
+    return [
+        # args 8192 B + the (64,64) intermediate 16384 B dwarf the budget
+        (Target("hbm_overrun", "liveness peak exceeds hbm_budget",
+                _program, src, meta={"hbm_budget": 1024}), True),
+        (Target("hbm_unpriced", "no hbm_budget declared",
+                _program, src, meta={}), True),
+        (Target("hbm_within", "liveness peak fits hbm_budget",
+                _program, src, meta={"hbm_budget": 1 << 20}), False),
+    ]

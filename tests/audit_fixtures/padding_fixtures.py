@@ -1,0 +1,56 @@
+"""padding-waste fixtures: a routed all_to_all whose bucket cap is 8x
+the declared demand — 87.5% of the shipped lanes are padding bought with
+real HBM and wire bytes (positive) — vs the exact analytic cap, where
+every lane is payload (negative)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.control.cost import routed_lanes_per_hop
+from quiver_tpu.parallel.mesh import FEATURE_AXIS, make_mesh, shard_map
+from quiver_tpu.tools.audit.audit_targets import Target
+
+_F = 2
+_LOCAL = 16
+_ALPHA = 1.0
+_FEAT = 4
+
+
+def _program(cap):
+    mesh = make_mesh(2, data=1, feature=2)
+
+    def body(ids, rows):
+        routed = jax.lax.all_to_all(
+            ids.reshape(_F, cap), FEATURE_AXIS, 0, 0)
+        payload = jax.lax.all_to_all(
+            rows.reshape(_F, cap, _FEAT), FEATURE_AXIS, 0, 0)
+        return payload.reshape(_F * cap, _FEAT)[
+            jnp.clip(routed.reshape(-1), 0, _F * cap - 1)
+        ]
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(FEATURE_AXIS), P(FEATURE_AXIS, None)),
+        out_specs=P(FEATURE_AXIS, None),
+        check_vma=False,
+    ))
+    return fn.trace(
+        jax.ShapeDtypeStruct((2 * _F * cap,), jnp.int32),
+        jax.ShapeDtypeStruct((2 * _F * cap, _FEAT), jnp.float32),
+    )
+
+
+def targets():
+    src = ("tests/audit_fixtures/padding_fixtures.py",)
+    meta = {"comm": {"feature_shards": _F, "local_len": _LOCAL,
+                     "alpha": _ALPHA, "feature_dim": _FEAT}}
+    model_cap = int(routed_lanes_per_hop(_LOCAL, _F, _ALPHA)["cap"])
+    return [
+        # 8x the analytic cap: waste = 1 - 16/128 = 0.875 > 0.6
+        (Target("padding_overcap", "cap over-provisioned 8x for the route",
+                lambda: _program(8 * model_cap), src, meta=meta), True),
+        # the exact cap: waste = 1 - 16/16 = 0
+        (Target("padding_exact", "every shipped lane is payload",
+                lambda: _program(model_cap), src, meta=meta), False),
+    ]

@@ -1,0 +1,56 @@
+"""no-silent-replication fixtures: a feature-sharded table gathered to
+full replication on every device (positive) vs the same traffic routed
+through all_to_all, which keeps per-device bytes constant (negative)."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from quiver_tpu.parallel.mesh import FEATURE_AXIS, make_mesh, shard_map
+from quiver_tpu.tools.audit.audit_targets import Target
+
+_N, _F = 64, 32  # global table: (64, 32) f32, feature-sharded to (32, 32)
+
+
+def _gather_program():
+    mesh = make_mesh(2, data=1, feature=2)
+
+    def body(x):
+        # the silent-replication cliff: every device materializes the
+        # FULL (64, 32) table — 8192 bytes, F x the sharded footprint
+        g = jax.lax.all_gather(x, FEATURE_AXIS, tiled=True)
+        return g.sum(axis=0)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(FEATURE_AXIS, None),),
+        out_specs=P(), check_vma=False,
+    ))
+    return fn.trace(jax.ShapeDtypeStruct((_N, _F), jnp.float32))
+
+
+def _routed_program():
+    mesh = make_mesh(2, data=1, feature=2)
+
+    def body(x):
+        # same bytes exchanged, but per-device residency stays (32, 32)
+        r = jax.lax.all_to_all(x.reshape(2, _N // 4, _F), FEATURE_AXIS,
+                               0, 0)
+        return r.reshape(_N // 2, _F).sum(axis=0)
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(FEATURE_AXIS, None),),
+        out_specs=P(), check_vma=False,
+    ))
+    return fn.trace(jax.ShapeDtypeStruct((_N, _F), jnp.float32))
+
+
+def targets():
+    src = ("tests/audit_fixtures/replication_fixtures.py",)
+    return [
+        (Target("replication_gather",
+                "feature-axis all_gather replicates the table",
+                _gather_program, src), True),
+        (Target("replication_routed",
+                "all_to_all keeps per-device bytes constant",
+                _routed_program, src), False),
+    ]

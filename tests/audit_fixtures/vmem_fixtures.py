@@ -1,0 +1,42 @@
+"""vmem-budget fixtures: a Pallas block spec whose resident VMEM blocks
+overflow a TPU core's ~16 MB (positive) vs a tile that fits (negative).
+Interpret-mode, trace-only — the block *shapes* are the invariant."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from quiver_tpu.tools.audit.audit_targets import Target
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _program(n):
+    def fn(x):
+        return pl.pallas_call(
+            _kernel,
+            grid=(1,),
+            in_specs=[pl.BlockSpec((n, n), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((n, n), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+            interpret=True,
+        )(x)
+
+    return jax.jit(fn).trace(jax.ShapeDtypeStruct((n, n), jnp.float32))
+
+
+def targets():
+    src = ("tests/audit_fixtures/vmem_fixtures.py",)
+    return [
+        # in + out blocks are 16 MiB EACH: 32 MiB resident > the 16 MiB
+        # per-core budget — this block spec cannot schedule on a TPU core
+        (Target("vmem_overrun", "resident Pallas blocks overflow VMEM",
+                lambda: _program(2048), src), True),
+        (Target("vmem_within", "tile fits the per-core VMEM budget",
+                lambda: _program(64), src), False),
+    ]

@@ -21,8 +21,12 @@ from audit_fixtures import (
     constant_fixtures,
     donation_fixtures,
     dtype_fixtures,
+    hbm_fixtures,
     metrics_fixtures,
+    padding_fixtures,
     parity_fixtures,
+    replication_fixtures,
+    vmem_fixtures,
 )
 
 _FIXTURES = {
@@ -32,6 +36,10 @@ _FIXTURES = {
     "dtype-discipline": dtype_fixtures,
     "constant-bloat": constant_fixtures,
     "comm-budget": comm_fixtures,
+    "peak-hbm-budget": hbm_fixtures,
+    "no-silent-replication": replication_fixtures,
+    "vmem-budget": vmem_fixtures,
+    "padding-waste": padding_fixtures,
 }
 
 
@@ -61,7 +69,7 @@ def test_rule_catches_its_positive_fixture(rule):
             )
 
 
-# slow lane: tracing + lowering all 13 registry programs is ~18s, and the
+# slow lane: tracing + lowering all 14 registry programs is ~20s, and the
 # CI audit job already gates the full registry twice per push (the
 # authoritative `python -m quiver_tpu.tools.audit --sarif` run plus this
 # file with no marker filter) — tier-1 keeps the per-rule fixture tests
@@ -115,10 +123,73 @@ def test_donating_epoch_donates_exactly_its_claim():
                for a in main_arg_attrs(plain.mlir))
 
 
+def test_donation_parser_pairs_operands_to_results():
+    """main_arg_attrs against zero/partial/full donation: not just the
+    donated COUNT but the operand<->result pairing — a pre-aliased arg's
+    ``alias_output`` names the flattened result it writes into, tracking
+    the matching result's POSITION, and an unusable donation leaves no
+    attr (it surfaces as a warning only)."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu.tools.audit.ir import main_arg_attrs
+
+    a = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    b = jax.ShapeDtypeStruct((16,), jnp.float32)
+
+    def f(x, y):
+        return x * 2.0, jnp.concatenate([y, y])
+
+    def g(x, y):  # same programs, result order flipped
+        return jnp.concatenate([y, y]), x * 2.0
+
+    def attrs_of(fn, donate):
+        with warnings.catch_warnings(record=True) as wlist:
+            warnings.simplefilter("always")
+            txt = jax.jit(fn, donate_argnums=donate).trace(
+                a, b).lower().as_text()
+        warned = [w for w in wlist if "donat" in str(w.message).lower()]
+        return main_arg_attrs(txt), warned
+
+    # zero donation: no attrs at all
+    none, warned = attrs_of(f, ())
+    assert len(none) == 2 and not warned
+    assert all(not x["aliased"] and not x["donor"]
+               and x["alias_output"] is None for x in none)
+
+    # partial: x pre-aliases the same-shaped result — at index 0 in f,
+    # index 1 in g: the parser reports the PAIRING, not a bare count
+    part_f, warned = attrs_of(f, (0,))
+    assert not warned
+    assert (part_f[0]["aliased"], part_f[0]["alias_output"]) == (True, 0)
+    assert part_f[1] == {"aliased": False, "donor": False,
+                         "alias_output": None}
+    part_g, _ = attrs_of(g, (0,))
+    assert (part_g[0]["aliased"], part_g[0]["alias_output"]) == (True, 1)
+
+    # full donation: y has no same-shaped result, so its donation is
+    # UNUSABLE — no attr lowers for it, only the build warning (exactly
+    # what the donation-audit rule counts on)
+    full, warned = attrs_of(f, (0, 1))
+    assert (full[0]["aliased"], full[0]["alias_output"]) == (True, 0)
+    assert not full[1]["aliased"] and not full[1]["donor"]
+    assert warned, "unusable donation must surface as a warning"
+
+
 def test_changed_scoping_and_target_selection():
     assert select_targets(changed=set()) == []
     hit = select_targets(changed={"quiver_tpu/serving/ladder.py"})
-    assert set(hit) == {"serve_forward", "serve_sample"}
+    assert set(hit) == {"serve_forward", "serve_sample",
+                        "serve_fleet_forward"}
+    # PR 16-18 modules now scope to the targets that trace them
+    assert "mmap_tiered_gather" in select_targets(
+        changed={"quiver_tpu/ooc/store.py"})
+    assert "serve_fleet_forward" in select_targets(
+        changed={"quiver_tpu/serving/aot.py"})
+    assert "pallas_fused_interp" in select_targets(
+        changed={"quiver_tpu/ops/election.py"})
     # editing the auditor itself re-audits everything
     assert set(select_targets(
         changed={"quiver_tpu/tools/audit/rules.py"})) == set(REGISTRY)
