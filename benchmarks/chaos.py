@@ -47,7 +47,14 @@ layer guarantees (quiver_tpu/resilience/):
   disk run), and a TORN raw directory (COMMIT marker missing) raises
   ``CorruptRawDir`` at load, is quarantined aside, and the loader falls
   back to the legacy ``.npz`` of the same topology with sampling
-  bit-identical to the original.
+  bit-identical to the original;
+* **postmortem**: the flight-recorder drill (quiver_tpu/obs/recorder.py)
+  — every fault class above that wires a recorder (nonfinite-guard trip,
+  circuit-breaker opening, aborted streaming commit) dumps an
+  integrity-verified (CRC-manifested, COMMIT-marker-last) postmortem
+  bundle naming the faulting stage (``train``/``gather``/``commit``),
+  and a TORN bundle directory is quarantined aside — never trusted —
+  while the earlier bundles keep verifying.
 
 Any drill failure raises (the session marks the job failed); success
 prints one ``CHAOS <drill> OK`` line per drill. ``--drills`` selects a
@@ -65,7 +72,7 @@ import numpy as np
 from benchmarks import common
 
 DRILLS = ("guard", "retry", "preempt", "resize", "corrupt", "cold-outage",
-          "pipeline", "mutate", "scale-out", "ooc")
+          "pipeline", "mutate", "scale-out", "ooc", "postmortem")
 
 
 def _build_graph(nodes: int, feature_dim: int, seed: int):
@@ -84,7 +91,7 @@ def _build_graph(nodes: int, feature_dim: int, seed: int):
 
 def _build_trainer(topo, feat, local_batch, plan=None, guard=False,
                    checkpoint_dir=None, checkpoint_every=0,
-                   pipeline_depth=0):
+                   pipeline_depth=0, tracer=None, recorder=None):
     import optax
 
     from quiver_tpu import Feature, GraphSageSampler
@@ -105,7 +112,8 @@ def _build_trainer(topo, feat, local_batch, plan=None, guard=False,
     return DistributedTrainer(
         mesh, sampler, feature, model, optax.sgd(1e-2),
         local_batch=local_batch, nonfinite_guard=guard, fault_plan=plan,
-        pipeline_depth=pipeline_depth, **kw
+        pipeline_depth=pipeline_depth, tracer=tracer, recorder=recorder,
+        **kw
     )
 
 
@@ -514,6 +522,110 @@ def drill_cold_outage(topo, feat, labels, local_batch, seed):
     )
 
 
+def drill_postmortem(topo, feat, labels, local_batch, seed):
+    """Every chaos fault class dumps an integrity-verified postmortem
+    bundle naming the faulting stage — guard trip (train), breaker open
+    (gather), aborted streaming commit (commit) — and a torn bundle
+    directory is quarantined, never trusted, while the earlier bundles
+    keep verifying."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from quiver_tpu import (
+        CommitAborted,
+        CSRTopo,
+        DegradedFeature,
+        DeltaBatch,
+        FaultPlan,
+        Feature,
+        FlightRecorder,
+        StreamingGraph,
+        Tracer,
+        TransientFault,
+    )
+    from quiver_tpu.obs.recorder import TornBundle, list_bundles, \
+        verify_bundle
+
+    rng = np.random.default_rng(seed)
+    n = topo.node_count
+    with tempfile.TemporaryDirectory() as tmp:
+        tracer = Tracer()
+        rec = FlightRecorder(tmp, capacity=64, keep=8, tracer=tracer)
+
+        # fault class 1 — nonfinite-guard trip names stage "train"
+        plan = FaultPlan(nan_feature_steps=(1,), nan_rows=8)
+        trainer = _build_trainer(topo, feat, local_batch, plan=plan,
+                                 guard=True, tracer=tracer, recorder=rec)
+        params, opt = trainer.init(jax.random.PRNGKey(0))
+        lab = jnp.asarray(labels)
+        for step in range(2):
+            params, opt, _loss = trainer.step(
+                params, opt, rng.integers(0, n, trainer.global_batch),
+                lab, jax.random.PRNGKey(step),
+            )
+
+        # fault class 2 — the breaker opening names stage "gather"
+        store = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+        degraded = DegradedFeature(
+            FaultPlan(feature_faults={0: 5}).wrap_feature(store),
+            failures=3, probe_every=2, fallback="zeros", recorder=rec,
+        )
+        ids = rng.integers(0, n, 4)
+        for _ in range(2):  # closed breaker propagates the outage
+            try:
+                degraded[ids]
+                raise AssertionError("closed breaker swallowed the fault")
+            except TransientFault:
+                pass
+        degraded[ids]  # third consecutive failure opens it -> bundle
+        assert degraded.breaker.state == "open", degraded.breaker.state
+
+        # fault class 3 — an aborted streaming commit names stage "commit"
+        sg = StreamingGraph(
+            CSRTopo(indptr=topo.indptr, indices=topo.indices),
+            recorder=rec,
+        )
+        assert sg.ingest(DeltaBatch(
+            edge_inserts=rng.integers(0, n, size=(2, 8))
+        )), "good delta batch rejected"
+        try:
+            sg.commit(inject_failure="merge")
+            raise AssertionError("injected commit failure did not abort")
+        except CommitAborted:
+            pass
+
+        stages = {m["reason"]: m["stage"] for _p, m in rec.bundles()}
+        want = {"nonfinite_guard": "train", "breaker_open": "gather",
+                "commit_abort": "commit"}
+        for reason, stage in want.items():
+            assert stages.get(reason) == stage, \
+                f"{reason}: stage {stages.get(reason)!r} != {stage!r}"
+        for path, _m in rec.bundles():
+            verify_bundle(path)  # raises TornBundle on any corruption
+
+        # fault class 4 — a torn dump is quarantined, never trusted
+        torn = rec.trigger("torn_drill", stage="train",
+                           inject_failure="torn")
+        try:
+            verify_bundle(torn)
+            raise AssertionError("torn bundle passed verification")
+        except TornBundle:
+            pass
+        survivors = list_bundles(tmp, quarantine=True)
+        assert len(survivors) == len(want), \
+            f"{len(survivors)} bundles survived, expected {len(want)}"
+        assert any(name.startswith("quarantine-")
+                   for name in os.listdir(tmp)), "torn dir not quarantined"
+        for path, _m in survivors:
+            verify_bundle(path)  # quarantine left the good bundles intact
+        common.log(
+            f"CHAOS postmortem OK ({len(want)} fault classes bundled + "
+            "verified, torn dir quarantined)"
+        )
+
+
 def drill_scale_out(topo, feat, seed):
     """Serving-fleet scale-out: a replica joining mid-traffic warms from
     the shared AOT cache (zero compiles) and answers the same
@@ -840,6 +952,10 @@ def main():
             drill_scale_out(topo, feat, args.seed)
         if "ooc" in selected:
             drill_ooc(topo, feat, labels, args.local_batch, args.seed)
+        if "postmortem" in selected:
+            drill_postmortem(
+                topo, feat, labels, args.local_batch, args.seed
+            )
         common.log(f"CHAOS all drills passed ({', '.join(selected)})")
         return 0
 

@@ -7,11 +7,17 @@ recorded instead of propagated) and writes:
 
 * ``docs/TPU_RESULTS.md`` — the scoreboard table, every row stamped with
   its platform, vs the reference's published numbers (BASELINE.md);
-* ``docs/tpu_results.json`` — the raw records.
+* ``docs/tpu_results.json`` — the raw records;
+* ``BENCH_TRAJECTORY.jsonl`` (repo root) — one consolidated record per
+  round, appended, never rewritten: round-over-round movement of every
+  headline metric survives even when the per-round table is regenerated
+  whole. ``--backfill-trajectory`` reconstructs the early rounds from the
+  archived ``BENCH_r0*.json`` supervisor captures.
 
     python -m benchmarks.scoreboard                 # full run
     python -m benchmarks.scoreboard --smoke         # small shapes
     python -m benchmarks.scoreboard --only sampler-hbm feature-replicate
+    python -m benchmarks.scoreboard --backfill-trajectory
 
 A row whose ``platform`` is not ``tpu`` means the chip was unreachable for
 that run; re-run when it frees up. The table is regenerated whole each time.
@@ -26,6 +32,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAJECTORY = os.path.join(REPO, "BENCH_TRAJECTORY.jsonl")
 
 # (key, module, args, baseline note)
 JOBS = [
@@ -251,6 +258,109 @@ def run_job(module, extra, smoke, timeout_s):
     return recs, err, time.time() - t0
 
 
+def _headline(rec):
+    """Trajectory row for one benchmark record: the headline metric plus
+    just enough provenance to compare rounds (full detail stays in
+    tpu_results.json)."""
+    row = {
+        "metric": rec.get("metric"),
+        "value": rec.get("value"),
+        "unit": rec.get("unit", ""),
+        "platform": rec.get("platform", "?"),
+    }
+    if rec.get("vs_baseline") is not None:
+        row["vs_baseline"] = rec["vs_baseline"]
+    if rec.get("degraded"):
+        row["degraded"] = True
+    if rec.get("smoke"):
+        row["smoke"] = True
+    return row
+
+
+def _run_mode(rows):
+    """``tpu`` when any row is an undegraded full-scale chip number,
+    else ``cpu-smoke`` — the label the trajectory plots group by."""
+    for row in rows.values():
+        if (row.get("platform") == "tpu" and not row.get("degraded")
+                and not row.get("smoke")):
+            return "tpu"
+    return "cpu-smoke"
+
+
+def append_trajectory(entry, path=TRAJECTORY):
+    """Append one consolidated per-round record to the trajectory ledger.
+
+    Append-only on purpose: TPU_RESULTS.md and tpu_results.json are
+    regenerated whole each round, so they only ever show the latest
+    state; the ledger is the round-over-round history."""
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+def trajectory_from_results(results, smoke, stamp):
+    rows = {}
+    for job in results:
+        recs = job.get("records") or []
+        if recs:
+            # first record of a job is its headline (bench modules emit
+            # the primary number first, attribution rows after)
+            rows[job["key"]] = _headline(recs[0])
+        else:
+            rows[job["key"]] = {"error": (job.get("error") or "failed")[:200]}
+    return {
+        "when": stamp,
+        "source": "scoreboard" + (" --smoke" if smoke else ""),
+        "mode": _run_mode(rows),
+        "rows": rows,
+    }
+
+
+def backfill_trajectory(path=TRAJECTORY):
+    """Reconstruct the early rounds from the archived ``BENCH_r0*.json``
+    supervisor captures and splice them in FRONT of any records already
+    in the ledger (which are newer by construction). Prior backfilled
+    round entries are replaced, not duplicated, so the command is
+    idempotent; scoreboard-appended entries are preserved."""
+    kept = []
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if d.get("source") != "bench.py":
+                    kept.append(d)
+    rounds = []
+    for name in sorted(os.listdir(REPO)):
+        if not (name.startswith("BENCH_r") and name.endswith(".json")):
+            continue
+        with open(os.path.join(REPO, name)) as fh:
+            cap = json.load(fh)
+        parsed = cap.get("parsed")
+        if parsed:
+            rows = {"sampler-hbm": _headline(parsed)}
+        else:
+            rows = {}
+        entry = {
+            "round": cap.get("n"),
+            "source": "bench.py",
+            "archive": name,
+            "mode": _run_mode(rows),
+            "rows": rows,
+        }
+        if not rows:
+            entry["error"] = f"rc={cap.get('rc')}: no parsed record"
+        rounds.append(entry)
+    with open(path, "w", encoding="utf-8") as fh:
+        for d in rounds + kept:
+            fh.write(json.dumps(d, sort_keys=True) + "\n")
+    return len(rounds), len(kept)
+
+
 def fmt_value(rec):
     v, unit = rec.get("value"), rec.get("unit", "")
     if v is None:
@@ -266,7 +376,16 @@ def main():
     p.add_argument("--only", nargs="*", default=None,
                    help="subset of job keys to run")
     p.add_argument("--out", default=os.path.join(REPO, "docs"))
+    p.add_argument("--backfill-trajectory", action="store_true",
+                   help="rebuild the early BENCH_TRAJECTORY.jsonl rounds "
+                        "from the archived BENCH_r0*.json captures and exit")
     args = p.parse_args()
+
+    if args.backfill_trajectory:
+        n_rounds, n_kept = backfill_trajectory()
+        print(f"[scoreboard] trajectory: {n_rounds} backfilled rounds + "
+              f"{n_kept} kept entries -> {TRAJECTORY}", file=sys.stderr)
+        return
 
     known = {key for key, *_ in JOBS}
     if args.only:
@@ -409,6 +528,7 @@ def write_outputs(results, out, smoke, merge=False):
     ]
     with open(os.path.join(out, "TPU_RESULTS.md"), "w") as fh:
         fh.write("\n".join(lines))
+    append_trajectory(trajectory_from_results(results, smoke, stamp))
     print("\n".join(lines))
 
 

@@ -32,9 +32,12 @@ from .sampling.saint import (
     saint_subgraph,
 )
 from .obs import (
+    FlightRecorder,
     MetricSnapshot,
     MetricsRegistry,
     StepTimeline,
+    TelemetryEndpoint,
+    Tracer,
     profile_epoch,
 )
 from .ooc import (
@@ -127,6 +130,9 @@ __all__ = [
     "MetricSnapshot",
     "StepTimeline",
     "profile_epoch",
+    "Tracer",
+    "FlightRecorder",
+    "TelemetryEndpoint",
     "MmapFeatureStore",
     "AsyncStager",
     "CorruptRawDir",

@@ -174,11 +174,18 @@ class CacheController:
                  decision_log=None, heat_bins: int = 256,
                  alpha_tuner: AlphaTuner | None = None,
                  split_tuner: SplitTuner | None = None,
-                 repin_min_gain: float = 0.02):
+                 repin_min_gain: float = 0.02, tracer=None,
+                 recorder=None):
         self.sketch = sketch
         self.cost = cost
         self.frozen = bool(frozen)
         self.decision_log = decision_log
+        # grafttrace/recorder seams: every audited decision lands as a
+        # zero-duration span (subsystem "control") and a flight-recorder
+        # ring note, so a postmortem bundle shows the placement decisions
+        # leading up to the fault
+        self.tracer = tracer
+        self.recorder = recorder
         self.heat_bins = int(heat_bins)
         self.alpha_tuner = alpha_tuner if alpha_tuner is not None \
             else AlphaTuner()
@@ -445,6 +452,12 @@ class CacheController:
         entry = {"decision": decision, **record}
         self.decisions.append(entry)
         get_logger("ctrl").info("decision %s: %s", decision, record)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.event(
+                f"ctrl.{decision}", subsystem="control", **record
+            )
+        if self.recorder is not None:
+            self.recorder.note(f"ctrl.{decision}", **record)
         if self.decision_log is not None:
             snap = self.metrics.snapshot(counter)
             write_jsonl([snap], self.decision_log, extra=entry)

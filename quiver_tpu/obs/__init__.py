@@ -18,8 +18,20 @@ pieces:
 
 ``DistributedTrainer.metrics_report()`` is the one-call summary over all
 of it.
+
+grafttrace extends the layer with causal chains and crash forensics:
+
+* :class:`Tracer` / :class:`Span` — per-request/per-step causal spans
+  riding the serve, fleet, trainer, host-actor, and control seams,
+  exported as Chrome trace-event JSON (``tracing.py``);
+* :class:`FlightRecorder` — bounded black-box ring dumping atomic,
+  integrity-checksummed postmortem bundles on fault triggers
+  (``recorder.py``);
+* :class:`TelemetryEndpoint` — opt-in stdlib HTTP thread serving
+  ``/metrics``, ``/traces``, ``/healthz`` (``endpoint.py``).
 """
 
+from .endpoint import TelemetryEndpoint
 from .export import (
     from_prometheus,
     prometheus_name,
@@ -30,18 +42,28 @@ from .export import (
     write_jsonl,
 )
 from .profile import profile_epoch
+from .recorder import (
+    FlightRecorder,
+    TornBundle,
+    list_bundles,
+    verify_bundle,
+)
 from .registry import (
     GUARD_NONFINITE,
     GUARD_SKIPPED,
+    RECORDER_BUNDLES,
+    RECORDER_EVENTS,
     ROUTED_OVERFLOW,
     SAMPLE_OVERFLOW,
     TIER_HITS,
+    TRACE_SPANS,
     MetricSnapshot,
     MetricSpec,
     MetricsRegistry,
     MetricsTape,
 )
 from .timeline import P2Quantile, StageStats, StepTimeline
+from .tracing import Span, Tracer, to_chrome_trace, write_chrome_trace
 
 __all__ = [
     "MetricSpec",
@@ -64,4 +86,16 @@ __all__ = [
     "from_prometheus",
     "prometheus_name",
     "profile_epoch",
+    "Span",
+    "Tracer",
+    "TRACE_SPANS",
+    "RECORDER_BUNDLES",
+    "RECORDER_EVENTS",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "FlightRecorder",
+    "TornBundle",
+    "verify_bundle",
+    "list_bundles",
+    "TelemetryEndpoint",
 ]

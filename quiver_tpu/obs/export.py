@@ -33,6 +33,7 @@ __all__ = [
     "to_prometheus",
     "from_prometheus",
     "prometheus_name",
+    "escape_label_value",
 ]
 
 
@@ -112,27 +113,66 @@ def prometheus_name(name: str) -> str:
     return "quiver_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the exposition format: backslash, double
+    quote, and newline must be escaped or a hostile name breaks the line
+    out of its sample (label injection)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(value: str) -> str:
+    # HELP text: backslash and newline escape; quotes are legal verbatim
+    return str(value).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def to_prometheus(snapshots) -> str:
-    """Text exposition of the snapshots (one sample per array element)."""
+    """Text exposition of the snapshots (one sample per array element).
+
+    Hygiene: dotted/hostile metric names sanitize via
+    :func:`prometheus_name` (distinct names that sanitize to the same
+    exposition name get a ``_2``/``_3`` suffix instead of silently
+    merging); every metric emits ``# HELP`` (escaped) and ``# TYPE``;
+    the original name rides both as an escaped ``name=""`` label on each
+    sample and in the ``# QUIVER`` JSON metadata comment, which is what
+    makes :func:`from_prometheus` a lossless inverse even for names
+    containing ``\\``, ``"`` or newlines."""
     out = io.StringIO()
+    assigned: dict[str, str] = {}  # dotted name -> exposition name
     for snap in snapshots:
         arr = snap.numpy
-        pname = prometheus_name(snap.name)
-        shape = ",".join(str(s) for s in arr.shape)
-        out.write(
-            f"# QUIVER {pname} name={snap.name} kind={snap.kind} "
-            f"dtype={arr.dtype.name} steps={snap.steps} "
-            f"shape={shape or '-'}\n"
-        )
-        if snap.doc:
-            out.write(f"# HELP {pname} {snap.doc.splitlines()[0]}\n")
+        pname = assigned.get(snap.name)
+        if pname is None:
+            base = prometheus_name(snap.name)
+            pname, n = base, 1
+            taken = set(assigned.values())
+            while pname in taken:
+                n += 1
+                pname = f"{base}_{n}"
+            assigned[snap.name] = pname
+        meta = {
+            "pname": pname,
+            "name": snap.name,
+            "kind": snap.kind,
+            "dtype": arr.dtype.name,
+            "steps": snap.steps,
+            "shape": list(arr.shape),
+            "unit": snap.unit,
+            "doc": snap.doc,
+        }
+        out.write(f"# QUIVER {json.dumps(meta, sort_keys=True)}\n")
+        out.write(f"# HELP {pname} {_escape_help(snap.doc)}\n")
         out.write(f"# TYPE {pname} {snap.kind}\n")
+        name_lbl = escape_label_value(snap.name)
         if arr.ndim == 0:
-            out.write(f"{pname} {_fmt(arr[()])}\n")
+            out.write(f'{pname}{{name="{name_lbl}"}} {_fmt(arr[()])}\n')
         else:
             for idx in np.ndindex(arr.shape):
                 lbl = ",".join(str(i) for i in idx)
-                out.write(f'{pname}{{idx="{lbl}"}} {_fmt(arr[idx])}\n')
+                out.write(
+                    f'{pname}{{name="{name_lbl}",idx="{lbl}"}} '
+                    f"{_fmt(arr[idx])}\n"
+                )
     return out.getvalue()
 
 
@@ -144,18 +184,47 @@ def _fmt(v) -> str:
 
 _SAMPLE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?:\{idx="(?P<idx>[0-9,]*)"\})?\s+(?P<val>\S+)$'
+    r'(?:\{(?P<labels>.*)\})?\s+(?P<val>\S+)$'
 )
+# idx label anchored at the END of the label block — a hostile name label
+# (escaped, quoted, emitted first) cannot spoof it
+_IDX = re.compile(r'(?:^|,)idx="(?P<idx>[0-9,]*)"$')
+# legacy space-separated metadata comment (pre-hygiene expositions)
 _META = re.compile(
     r"^# QUIVER (?P<pname>\S+) name=(?P<name>\S+) kind=(?P<kind>\S+) "
     r"dtype=(?P<dtype>\S+) steps=(?P<steps>\S+) shape=(?P<shape>\S+)$"
 )
 
 
+def _parse_meta(line: str) -> dict | None:
+    body = line[len("# QUIVER "):]
+    if body.startswith("{"):
+        try:
+            d = json.loads(body)
+        except ValueError:
+            return None
+        if isinstance(d, dict) and "pname" in d:
+            d["shape"] = tuple(d.get("shape") or ())
+            return d
+        return None
+    m = _META.match(line)
+    if not m:
+        return None
+    d = m.groupdict()
+    d["steps"] = None if d["steps"] == "None" else int(d["steps"])
+    d["shape"] = (
+        () if d["shape"] == "-"
+        else tuple(int(s) for s in d["shape"].split(","))
+    )
+    return d
+
+
 def from_prometheus(text: str) -> list[MetricSnapshot]:
     """Parse an exposition produced by :func:`to_prometheus` back into
     snapshots (the ``# QUIVER`` metadata lines make the round trip
-    lossless — dtype, steps axis, and shape are all recovered)."""
+    lossless — original name, dtype, steps axis, shape, unit and doc are
+    all recovered, hostile names included). Legacy (pre-hygiene)
+    expositions parse too."""
     meta: dict[str, dict] = {}
     samples: dict[str, dict[tuple, str]] = {}
     order: list[str] = []
@@ -163,12 +232,12 @@ def from_prometheus(text: str) -> list[MetricSnapshot]:
         line = line.strip()
         if not line:
             continue
-        m = _META.match(line)
-        if m:
-            d = m.groupdict()
-            meta[d["pname"]] = d
-            if d["pname"] not in order:
-                order.append(d["pname"])
+        if line.startswith("# QUIVER "):
+            d = _parse_meta(line)
+            if d is not None:
+                meta[d["pname"]] = d
+                if d["pname"] not in order:
+                    order.append(d["pname"])
             continue
         if line.startswith("#"):
             continue
@@ -176,7 +245,12 @@ def from_prometheus(text: str) -> list[MetricSnapshot]:
         if not m:
             continue
         pname = m.group("name")
-        idx = m.group("idx")
+        labels = m.group("labels")
+        idx = None
+        if labels is not None:
+            mi = _IDX.search(labels)
+            if mi is not None:
+                idx = mi.group("idx")
         key = () if idx is None else tuple(
             int(i) for i in idx.split(",") if i != ""
         )
@@ -190,16 +264,15 @@ def from_prometheus(text: str) -> list[MetricSnapshot]:
         if md is None or not vals:
             continue
         dtype = np.dtype(md["dtype"])
-        shape = (
-            () if md["shape"] == "-"
-            else tuple(int(s) for s in md["shape"].split(","))
-        )
+        shape = tuple(md["shape"])
         arr = np.zeros(shape, dtype)
         for key, raw in vals.items():
             v = int(raw) if np.issubdtype(dtype, np.integer) else float(raw)
             arr[key] = v
-        steps = None if md["steps"] == "None" else int(md["steps"])
         out.append(
-            MetricSnapshot(md["name"], md["kind"], arr, steps)
+            MetricSnapshot(
+                md["name"], md["kind"], arr, md["steps"],
+                md.get("unit", ""), md.get("doc", ""),
+            )
         )
     return out

@@ -76,6 +76,9 @@ __all__ = [
     "OOC_STAGE_WAIT",
     "OOC_PAGE_READS",
     "OOC_READAHEAD_HITS",
+    "TRACE_SPANS",
+    "RECORDER_BUNDLES",
+    "RECORDER_EVENTS",
 ]
 
 # well-known metric names — the three streams the registry was distilled
@@ -163,6 +166,13 @@ CTRL_ALPHA_CHANGES = "ctrl.alpha_changes"
 # (quiver_tpu/ooc): one decision restages the whole host cold cache to
 # the sketch's measured-hottest disk rows
 CTRL_OOC_PROMOTIONS = "ctrl.ooc_promotions"
+# grafttrace (obs/tracing.py + obs/recorder.py): finished causal spans
+# recorded by the tracer (bounded ring keeps the newest), postmortem
+# bundles the flight recorder has published, and decision/audit events
+# noted into its ring buffer
+TRACE_SPANS = "trace.spans"
+RECORDER_BUNDLES = "recorder.bundles"
+RECORDER_EVENTS = "recorder.events"
 
 _KINDS = ("counter", "gauge")
 

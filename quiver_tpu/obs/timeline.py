@@ -19,6 +19,7 @@ its ``registry=`` argument).
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 
 import jax
@@ -96,8 +97,11 @@ class P2Quantile:
         h = self._heights
         if not h:
             return None
-        if self.count < 5:  # exact while the buffer is small
-            idx = min(int(round(self.q * (len(h) - 1))), len(h) - 1)
+        if self.count < 5:
+            # exact nearest-rank order statistic while the buffer is
+            # small: ceil(q*n) 1-based (round()-based indexing returned
+            # interpolated-garbage picks, e.g. p99 of {1,2} -> 1)
+            idx = max(0, math.ceil(self.q * len(h)) - 1)
             return h[idx]
         return h[2]
 

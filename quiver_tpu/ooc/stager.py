@@ -71,13 +71,18 @@ class AsyncStager:
       timeline: optional StepTimeline — per-event ``ooc.stage_wait``
         (exposed wait per fetch), ``ooc.read`` (each background read's
         duration), ``ooc.retry_wait`` (each backoff sleep).
+      tracer: optional grafttrace :class:`~quiver_tpu.obs.tracing
+        .Tracer` — the same per-event stages land as spans (subsystem
+        ``stager``) tagged with the causing ``trace`` id.
+      trace: trace id the stager's spans attach to.
     """
 
     def __init__(self, read_window, num_windows: int, window_rows: int,
                  cache_windows: int = 32, retries: int = 0,
                  backoff: float = 0.05, backoff_cap: float = 2.0,
                  jitter: float = 0.5, retry_seed: int = 0,
-                 metrics=None, timeline=None):
+                 metrics=None, timeline=None, tracer=None,
+                 trace: str | None = None):
         if num_windows < 1:
             raise ValueError(f"num_windows must be >= 1, got {num_windows}")
         if window_rows < 1:
@@ -129,6 +134,8 @@ class AsyncStager:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="quiver-ooc-stage"
         )
+        self.tracer = tracer
+        self.trace = trace
         self.page_reads_total = 0
         self.readahead_hits_total = 0
         self.read_retries_total = 0
@@ -139,6 +146,10 @@ class AsyncStager:
     def _observe(self, stage: str, seconds: float) -> None:
         if self.timeline is not None:
             self.timeline.observe(stage, seconds)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.observe(
+                stage, seconds, trace=self.trace, subsystem="stager",
+            )
 
     def _publish_counters(self) -> None:
         if self.metrics is not None:

@@ -128,6 +128,12 @@ class Prefetcher:
         ``resilience.skipped_steps``. The timeline gets per-event
         timings; the registry gets the running totals.
       retry_seed: seed for the jitter PRNG.
+      tracer: optional grafttrace :class:`~quiver_tpu.obs.tracing
+        .Tracer` — every successful dispatch lands a
+        ``prefetch.dispatch`` span (subsystem ``prefetch``) tagged with
+        the batch's stream index and the causing ``trace`` id.
+      trace: trace id the dispatch spans attach to (e.g. the trainer's
+        ``train.epoch.<n>``).
 
     ``retries_total`` / ``skips_total`` count across the prefetcher's
     lifetime (single worker thread — no synchronization needed).
@@ -150,6 +156,8 @@ class Prefetcher:
         timeline=None,
         metrics=None,
         retry_seed: int = 0,
+        tracer=None,
+        trace: str | None = None,
     ):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -194,12 +202,20 @@ class Prefetcher:
                     "sagging below it means dispatch is the bottleneck)",
             )
         self._jitter_rng = random.Random(retry_seed)
+        self.tracer = tracer
+        self.trace = trace
+        self._batch_index = 0  # worker-thread only (single worker)
         self.retries_total = 0
         self.skips_total = 0
 
     def _observe(self, stage: str, seconds: float) -> None:
         if self.timeline is not None:
             self.timeline.observe(stage, seconds)
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.observe(
+                stage, seconds, trace=self.trace, subsystem="prefetch",
+                batch=self._batch_index,
+            )
 
     def _publish_counters(self) -> None:
         """Land the running totals on the registry (host write from the
@@ -217,6 +233,7 @@ class Prefetcher:
 
     def _dispatch_resilient(self, seeds):
         """One batch with bounded retry; runs on the worker thread."""
+        self._batch_index += 1
         attempt = 0
         while True:
             t0 = time.perf_counter()

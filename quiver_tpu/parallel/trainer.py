@@ -53,6 +53,7 @@ from ..obs.registry import (
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
+from ..obs.tracing import Tracer
 from ..resilience.elastic import validate_resume_meta, worker_ordered_mean
 from ..resilience.faults import Preemption
 from ..resilience.guard import guard_verdict, guarded_update
@@ -190,6 +191,8 @@ class DistributedTrainer:
         pipeline_depth: int = 0,
         controller=None,
         donate_epoch_state: bool = False,
+        tracer: Tracer | None = None,
+        recorder=None,
     ):
         # beyond-HBM configs fuse too: HOST-mode topology and cold-tier
         # feature rows ride as mesh-replicated pinned-host operands, and the
@@ -341,6 +344,12 @@ class DistributedTrainer:
         self.fault_plan = fault_plan
         self._fault_step = 0  # eager step() call counter the plan indexes
         self._preempt_fired = False
+        # grafttrace: host-side span tracing (disabled tracer = zero work,
+        # bitwise-identical trajectory — spans are taken OUTSIDE every
+        # compiled program) + flight-recorder trigger on guard trips
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.recorder = recorder
+        self._guard_trips_seen = 0
         # checkpoint/auto-resume: checkpoint_dir= + checkpoint_every=
         # drive async atomic saves of (params, opt_state, step, PRNG key)
         # between scan chunks; resume() restores the latest and the
@@ -357,7 +366,8 @@ class DistributedTrainer:
             from ..utils.checkpoint import Checkpointer
 
             self.checkpointer = Checkpointer(
-                checkpoint_dir, max_to_keep=checkpoint_keep
+                checkpoint_dir, max_to_keep=checkpoint_keep,
+                tracer=self.tracer,
             )
             latest = self.checkpointer.latest_step()
             # a pre-existing run directory: keep manager ids monotonic
@@ -551,6 +561,33 @@ class DistributedTrainer:
             self.metrics, self.timeline,
             "" if self.collect_metrics else "; collect_metrics=False",
         )
+
+    def health(self) -> dict:
+        """The ``/healthz`` summary: worker geometry, bound streaming
+        versions, checkpoint progress, guard-trip count."""
+        topo_v, feat_v = self._current_versions()
+        return {
+            "workers": int(self.workers),
+            "global_batch": int(self.global_batch),
+            "topology_version": topo_v,
+            "feature_version": feat_v,
+            "checkpoint_seq": int(self._ckpt_seq),
+            "guard_trips": int(self._guard_trips_seen),
+        }
+
+    def serve_telemetry(self, host: str = "127.0.0.1",
+                        port: int = 0):
+        """Start (and return) a live telemetry endpoint over this
+        trainer: ``/metrics`` from its registry, ``/traces`` from its
+        tracer, ``/healthz`` from :meth:`health`. Off unless called —
+        the endpoint reads host-side snapshots only, so serving it
+        cannot perturb the compiled step."""
+        from ..obs.endpoint import TelemetryEndpoint
+
+        return TelemetryEndpoint(
+            metrics=self.metrics, tracer=self.tracer, health=self.health,
+            host=host, port=port,
+        ).start()
 
     # -- streaming-mutation versioning --------------------------------------
 
@@ -1163,6 +1200,21 @@ class DistributedTrainer:
             out[i, : len(b)] = b
         return out.reshape(-1)
 
+    def _check_guard_trip(self) -> None:
+        """Flight-recorder trigger: a nonfinite-guard trip (the guard
+        skipped >= 1 step since last checked) dumps a postmortem bundle
+        naming the train stage while the explaining spans/metrics are
+        still in the rings."""
+        if self.recorder is None or not self.nonfinite_guard:
+            return
+        snap = self.metrics.snapshot(GUARD_SKIPPED)
+        total = int(snap.total()) if snap is not None else 0
+        if total > self._guard_trips_seen:
+            self._guard_trips_seen = total
+            self.recorder.trigger(
+                "nonfinite_guard", stage="train", skipped_total=total,
+            )
+
     def step(self, params, opt_state, seeds, labels, key):
         """One fused step. ``seeds``: global seed array (host). ``labels``:
         full (N,) label array (replicated).
@@ -1189,7 +1241,9 @@ class DistributedTrainer:
         plan = self.fault_plan
         step_idx = self._fault_step
         self._fault_step += 1
-        with self.timeline.stage("step"):
+        with self.tracer.span("train.step", trace=f"train.step.{step_idx}",
+                              subsystem="trainer", step=step_idx), \
+                self.timeline.stage("step"):
             if isinstance(feature, ShardedFeature) and (
                 feature.auto_split
                 or getattr(feature, "_controller", None) is not None
@@ -1215,6 +1269,7 @@ class DistributedTrainer:
                 labels, key, inject
             )
         self.metrics.record(mtree)
+        self._check_guard_trip()
         if mtree and isinstance(feature, ShardedFeature):
             # hand the batch totals to the store so its eager split tuner
             # sees the fused path's traffic too
@@ -1402,6 +1457,10 @@ class DistributedTrainer:
         plan = self.fault_plan
         losses_parts: list = []
         mtrees_parts: list = []
+        # the epoch trace id is DETERMINISTIC (train.epoch.<n>): a
+        # preempted run's resume records its chunks under the same id,
+        # so the stitched timeline reads as one epoch across the restart
+        etrace = self.tracer.trace(f"train.epoch.{int(epoch)}")
         with self.timeline.stage("epoch_scan"):
             if isinstance(self.feature, ShardedFeature) and getattr(
                     self.feature, "_controller", None) is not None:
@@ -1430,9 +1489,19 @@ class DistributedTrainer:
             lo = start
             while lo < steps:
                 hi = min(lo + chunk, steps)
+                t0 = self.tracer.now() if self.tracer.enabled else 0.0
                 params, opt_state, losses, mtrees = self._epoch_fn(
                     params, opt_state, self.topo, self._feature_parts(),
                     packed[lo:hi], labels, keys[lo:hi], inject_vec[lo:hi]
+                )
+                # dispatch-timed (the device may still be running): under
+                # pipelining the chunk span's issue half is this dispatch,
+                # its train half drains inside the next blocking readback
+                self.tracer.record(
+                    "train.chunk", t0, self.tracer.now() - t0,
+                    trace=etrace, subsystem="trainer", epoch=int(epoch),
+                    start_step=lo, steps=hi - lo,
+                    pipeline_depth=self.pipeline_depth,
                 )
                 if self.pipeline_depth and lo > start:
                     # pipelined chunks after the first re-issue their
@@ -1445,6 +1514,10 @@ class DistributedTrainer:
                         PIPELINE_REISSUES,
                         np.int32(self._pipeline_reissues),
                     )
+                    self.tracer.event(
+                        "train.reissue", trace=etrace,
+                        subsystem="trainer", step=lo,
+                    )
                 losses_parts.append(losses)
                 mtrees_parts.append(mtrees)
                 if (plan is not None and not self._preempt_fired
@@ -1452,6 +1525,29 @@ class DistributedTrainer:
                     # the chunk ran but dies un-checkpointed — resume()
                     # restores step `lo` and replays from there
                     self._preempt_fired = True
+                    # land the partial epoch's telemetry before dying:
+                    # the guard trips that explain the preempted run must
+                    # reach the registry (and the flight recorder) even
+                    # though the final record below never runs
+                    if len(mtrees_parts) == 1:
+                        self.metrics.record(mtrees_parts[0])
+                    elif mtrees_parts:
+                        self.metrics.record({
+                            name: jnp.concatenate(
+                                [m[name] for m in mtrees_parts]
+                            )
+                            for name in mtrees_parts[0]
+                        })
+                    self._check_guard_trip()
+                    self.tracer.event(
+                        "train.preempt", trace=etrace,
+                        subsystem="trainer", step=plan.preempt_at_step,
+                    )
+                    if self.recorder is not None:
+                        self.recorder.note(
+                            "preemption", epoch=int(epoch),
+                            step=int(plan.preempt_at_step),
+                        )
                     raise Preemption(
                         f"simulated preemption at step "
                         f"{plan.preempt_at_step}: chunk [{lo}, {hi}) lost "
@@ -1460,7 +1556,7 @@ class DistributedTrainer:
                 if self.checkpointer is not None:
                     self._save_checkpoint(
                         params, opt_state, key, epoch, hi,
-                        steps_per_epoch=steps,
+                        steps_per_epoch=steps, trace=etrace,
                     )
                 lo = hi
         if len(losses_parts) == 1:
@@ -1474,6 +1570,7 @@ class DistributedTrainer:
         else:  # start == steps: a resumed, already-finished epoch
             losses, mtrees = jnp.zeros((0,), jnp.float32), {}
         self.metrics.record(mtrees)
+        self._check_guard_trip()
         if self.controller is not None:
             # epoch-boundary controller hooks: fold the epoch's stacked
             # heat into the sketch, hand the epoch's tier-hit totals to
@@ -1495,7 +1592,8 @@ class DistributedTrainer:
     # -- checkpoint / auto-resume -------------------------------------------
 
     def _save_checkpoint(self, params, opt_state, key, epoch, step,
-                         steps_per_epoch: int | None = None) -> None:
+                         steps_per_epoch: int | None = None,
+                         trace: str | None = None) -> None:
         """Async atomic save between scan chunks. ``step`` counts completed
         rows of the CURRENT epoch's packed seed matrix; ``key`` is the
         epoch's key0 (stored as raw key data — restore re-splits it). The
@@ -1528,7 +1626,8 @@ class DistributedTrainer:
         }
         if steps_per_epoch is not None:
             meta["steps_per_epoch"] = int(steps_per_epoch)
-        self.checkpointer.save(self._ckpt_seq, state, metadata=meta)
+        self.checkpointer.save(self._ckpt_seq, state, metadata=meta,
+                               trace=trace)
         self._ckpt_seq += 1
 
     def resume(self, params, opt_state, mesh: Mesh | None = None,

@@ -143,9 +143,15 @@ class CircuitBreaker:
       * ``half-open`` — after ``probe_every`` short-circuited calls, one
         probe attempts the real operation: success closes the breaker,
         failure re-opens it.
+
+    ``on_open`` (settable after construction) is called at every
+    closed/half-open -> open transition — the flight-recorder trigger
+    seam; exceptions it raises are swallowed (forensics must never make
+    an outage worse).
     """
 
-    def __init__(self, failures: int = 3, probe_every: int = 8):
+    def __init__(self, failures: int = 3, probe_every: int = 8,
+                 on_open=None):
         if failures < 1 or probe_every < 1:
             raise ValueError(
                 f"failures/probe_every must be >= 1, got "
@@ -153,6 +159,7 @@ class CircuitBreaker:
             )
         self.failures = int(failures)
         self.probe_every = int(probe_every)
+        self.on_open = on_open
         self.state = "closed"
         self._consecutive = 0
         self._since_probe = 0
@@ -191,6 +198,11 @@ class CircuitBreaker:
             )
             self.state = "open"
             self._since_probe = 0
+            if self.on_open is not None:
+                try:
+                    self.on_open()
+                except Exception:  # noqa: BLE001 — forensics must never
+                    pass           # make the outage worse
 
 
 class DegradedFeature:
@@ -218,13 +230,17 @@ class DegradedFeature:
         the budget; ``"zeros"`` keeps no cache).
       metrics: optional external :class:`MetricsRegistry` to land the
         degraded counter on (e.g. a trainer's); a private one otherwise.
+      recorder: optional :class:`~quiver_tpu.obs.recorder
+        .FlightRecorder` — a breaker-open transition dumps a postmortem
+        bundle naming the gather stage (the telemetry explaining the
+        outage is captured at the moment it starts).
     """
 
     _FALLBACKS = ("zeros", "last-good")
 
     def __init__(self, feature, failures: int = 3, probe_every: int = 8,
                  fallback: str = "zeros", cache_rows: int = 65536,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None, recorder=None):
         if fallback not in self._FALLBACKS:
             raise ValueError(
                 f"fallback must be one of {self._FALLBACKS}, "
@@ -232,6 +248,10 @@ class DegradedFeature:
             )
         self.feature = feature
         self.breaker = CircuitBreaker(failures, probe_every)
+        if recorder is not None:
+            self.breaker.on_open = lambda: recorder.trigger(
+                "breaker_open", stage="gather", fallback=fallback,
+            )
         self.fallback = fallback
         self.cache_rows = int(cache_rows)
         self.metrics = metrics if metrics is not None else MetricsRegistry()

@@ -69,6 +69,7 @@ class ServeRequest:
     t_done: float | None = None
     missed: bool | None = None
     shed: bool = False
+    trace_id: str = ""
 
     @property
     def deadline_at(self) -> float:

@@ -30,6 +30,8 @@ import time
 
 import numpy as np
 
+from ..obs.endpoint import TelemetryEndpoint
+from ..obs.tracing import Tracer
 from .aot import AOTExecutableCache
 from .coalesce import PRIORITIES, ServeQueueFull, ServeRequest
 from .server import InferenceServer
@@ -59,6 +61,14 @@ class ServingFleet:
       warm: warm each constructed replica from the cache immediately
         (cold-start timings land in :attr:`cold_starts`).
       clock: injectable clock handed to every replica's batcher.
+      tracer: optional shared grafttrace :class:`Tracer` handed to every
+        replica — the fleet opens ONE trace per submitted request before
+        routing, so a failover request's spans on both the rejecting and
+        the accepting replica share a single trace id. Default: a
+        disabled tracer.
+      recorder: optional shared :class:`~quiver_tpu.obs.recorder
+        .FlightRecorder` handed to every replica (shed-burst / breaker
+        triggers carry the replica index).
       **server_kwargs: forwarded to every :class:`InferenceServer`
         (``max_batch``, ``buckets``, ``class_deadlines``, ``max_queue``,
         ``degraded``, ...).
@@ -67,6 +77,7 @@ class ServingFleet:
     def __init__(self, sampler, model, params, feature, *,
                  replicas: int = 1, aot_cache=True, controller=None,
                  seed: int = 0, warm: bool = True, clock=time.monotonic,
+                 tracer: Tracer | None = None, recorder=None,
                  **server_kwargs):
         if aot_cache is not None and not isinstance(aot_cache,
                                                     AOTExecutableCache):
@@ -81,6 +92,8 @@ class ServingFleet:
         self.controller = controller
         self.seed = int(seed)
         self.clock = clock
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.recorder = recorder
         self._server_kwargs = dict(server_kwargs)
         self.servers: list[InferenceServer] = []
         #: per-replica join records: ``{"seconds", "loaded", "compiled"}``
@@ -102,8 +115,10 @@ class ServingFleet:
         srv = InferenceServer(
             self.sampler, self.model, self.params, self.feature,
             aot_cache=self.aot_cache, controller=self.controller,
-            seed=self.seed, clock=self.clock, **self._server_kwargs,
+            seed=self.seed, clock=self.clock, tracer=self.tracer,
+            recorder=self.recorder, **self._server_kwargs,
         )
+        srv.replica_index = len(self.servers)
         ws = {"loaded": 0, "compiled": 0}
         if warm:
             ws = srv.warm_from_cache() if self.aot_cache is not None \
@@ -124,12 +139,29 @@ class ServingFleet:
         :class:`ServeQueueFull` — fleet-level admission control."""
         if not self.servers:
             raise RuntimeError("fleet has no replicas; call add_replica()")
+        # one trace per request, opened BEFORE routing: every replica a
+        # failover touches records its spans under this id
+        tid = self.tracer.trace() if self.tracer.enabled else None
         last_err = None
+        first = True
         for srv in sorted(self.servers, key=lambda s: s.batcher.depth):
+            if tid is not None:
+                self.tracer.event(
+                    "fleet.route" if first else "fleet.failover",
+                    trace=tid, subsystem="fleet",
+                    replica=srv.replica_index, node=int(node),
+                    depth=srv.batcher.depth,
+                )
+            first = False
             try:
-                return srv.submit(node, deadline_s, priority)
+                return srv.submit(node, deadline_s, priority, trace_id=tid)
             except ServeQueueFull as e:
                 last_err = e
+        if tid is not None:
+            self.tracer.event(
+                "fleet.rejected", trace=tid, subsystem="fleet",
+                node=int(node),
+            )
         raise last_err
 
     def pump(self, force: bool = False) -> list[ServeRequest]:
@@ -177,6 +209,37 @@ class ServingFleet:
     def aot_loads(self) -> int:
         """Fleet-total programs warmed from the persisted cache."""
         return sum(s.aot_loads for s in self.servers)
+
+    def health(self) -> dict:
+        """The ``/healthz`` summary: per-replica queue depth, topology
+        version, breaker state (when the store is breaker-wrapped)."""
+        reps = []
+        for srv in self.servers:
+            breaker = getattr(srv.feature, "breaker", None)
+            reps.append({
+                "replica": srv.replica_index,
+                "queue_depth": srv.batcher.depth,
+                "topology_version": srv._topo_version,
+                "breaker": breaker.state if breaker is not None else None,
+            })
+        return {
+            "replicas": len(reps),
+            "queue_depth": sum(r["queue_depth"] for r in reps),
+            "per_replica": reps,
+        }
+
+    def serve_telemetry(self, host: str = "127.0.0.1",
+                        port: int = 0) -> TelemetryEndpoint:
+        """Start (and return) a live telemetry endpoint over the fleet:
+        ``/metrics`` from replica 0's registry, ``/traces`` from the
+        shared tracer, ``/healthz`` from :meth:`health`. Off unless
+        called; caller stops it (or relies on the daemon thread dying
+        with the process)."""
+        metrics = self.servers[0].metrics if self.servers else None
+        return TelemetryEndpoint(
+            metrics=metrics, tracer=self.tracer, health=self.health,
+            host=host, port=port,
+        ).start()
 
     def oracle(self, node: int, seq: int) -> np.ndarray:
         """The fleet-wide parity reference: replicas share the base seed,

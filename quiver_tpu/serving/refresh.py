@@ -50,11 +50,15 @@ class EmbeddingRefresher:
     """
 
     def __init__(self, model, params, csr_topo, features, *,
-                 infer_fn=None, chunk: int = 1 << 21, mode: str = "HBM"):
+                 infer_fn=None, chunk: int = 1 << 21, mode: str = "HBM",
+                 tracer=None):
         self.model = model
         self.params = params
         self.csr_topo = csr_topo
         self._features = features
+        # grafttrace seam: each recompute lands a serve.refresh span
+        # (subsystem "serve") tagged with the version it published
+        self.tracer = tracer
         self.infer_fn = infer_fn if infer_fn is not None else (
             sage_layerwise_inference
         )
@@ -79,6 +83,8 @@ class EmbeddingRefresher:
         served. Safe to call from the background thread while lookups
         proceed against the old table."""
         version = int(getattr(self.csr_topo, "version", 0))
+        t0 = (self.tracer.now()
+              if self.tracer is not None and self.tracer.enabled else None)
         x = self._features_now()
         logp = self.infer_fn(
             self.model, self.params, self.csr_topo, x,
@@ -89,6 +95,11 @@ class EmbeddingRefresher:
             self._table = table
             self._table_version = version
             self.refreshes += 1
+        if t0 is not None:
+            self.tracer.record(
+                "serve.refresh", t0, self.tracer.now() - t0,
+                subsystem="serve", version=version,
+            )
         return version
 
     # -- versioned reads -----------------------------------------------------

@@ -47,12 +47,36 @@ from ..obs.registry import (
     MetricsRegistry,
 )
 from ..obs.timeline import StepTimeline
+from ..obs.tracing import Tracer
 from ..resilience.elastic import DegradedFeature
 from .aot import AOTExecutableCache
 from .coalesce import PRIORITIES, DeadlineBatcher, ServeRequest, ladder_buckets
 from .ladder import ServeLadder
 
 __all__ = ["InferenceServer"]
+
+
+class _MarkedStage:
+    """Context manager pairing one :class:`StepTimeline` stage with a
+    grafttrace ``(name, t0, dur)`` mark on the server's tracer clock."""
+
+    __slots__ = ("_server", "_name", "_marks", "_inner", "_t0")
+
+    def __init__(self, server, name, marks):
+        self._server = server
+        self._name = name
+        self._marks = marks
+        self._inner = server.timeline.stage(name)
+
+    def __enter__(self):
+        self._t0 = self._server.tracer.now()
+        return self._inner.__enter__()
+
+    def __exit__(self, exc_type, exc, tb):
+        self._marks.append(
+            (self._name, self._t0, self._server.tracer.now() - self._t0)
+        )
+        return self._inner.__exit__(exc_type, exc, tb)
 
 
 class InferenceServer:
@@ -96,6 +120,16 @@ class InferenceServer:
         program builds consult the cache before compiling and publish
         after compiling; :meth:`warm_from_cache` is the compile-free
         replica cold-start path.
+      tracer: optional grafttrace :class:`~quiver_tpu.obs.tracing
+        .Tracer` — every admitted request opens (or joins, when the
+        fleet routed it) one trace, and the six batch stages land as
+        child spans of that trace. Default: a disabled tracer (no
+        overhead, bitwise-identical responses).
+      recorder: optional :class:`~quiver_tpu.obs.recorder
+        .FlightRecorder` — dumps a postmortem bundle on a shed burst
+        (``shed_burst`` sheds since the last dump) and, when this server
+        wraps its store in a ``DegradedFeature``, on breaker open.
+      shed_burst: shed-count threshold for the recorder trigger.
     """
 
     STAGES = ("queue_wait", "pad", "sample", "gather", "forward", "readback")
@@ -110,17 +144,23 @@ class InferenceServer:
                  metrics: MetricsRegistry | None = None,
                  timeline: StepTimeline | None = None,
                  controller=None, class_deadlines: dict | None = None,
-                 aot_cache=None):
+                 aot_cache=None, tracer: Tracer | None = None,
+                 recorder=None, shed_burst: int = 8):
         self.sampler = sampler
         self.model = model
         self.params = params
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.timeline = timeline if timeline is not None else StepTimeline()
+        self.tracer = tracer if tracer is not None else Tracer(enabled=False)
+        self.recorder = recorder
+        self.replica_index = 0
+        self.shed_burst = int(shed_burst)
+        self._shed_dumped = 0
         self.clock = clock
         if degraded is not None and not isinstance(feature, DegradedFeature):
             feature = DegradedFeature(
                 feature, failures=breaker_failures, probe_every=probe_every,
-                fallback=degraded, metrics=self.metrics,
+                fallback=degraded, metrics=self.metrics, recorder=recorder,
             )
         self.feature = feature
         self.controller = controller
@@ -222,6 +262,20 @@ class InferenceServer:
     def _sync_shed(self) -> None:
         shed = [self.batcher.shed_by_class[p] for p in PRIORITIES]
         self.metrics.set(SERVE_SHED, np.asarray(shed, np.int32))
+        total = int(sum(shed))
+        if self.recorder is not None:
+            if total > self._shed_dumped:
+                self.recorder.note(
+                    "serve.shed", replica=self.replica_index,
+                    shed_total=total,
+                )
+            if total - self._shed_dumped >= self.shed_burst:
+                self._shed_dumped = total
+                self.recorder.trigger(
+                    "shed_burst", stage="queue",
+                    replica=self.replica_index, shed_total=total,
+                    queue_depth=self.batcher.depth,
+                )
 
     # -- streaming-mutation versioning --------------------------------------
 
@@ -261,14 +315,27 @@ class InferenceServer:
     # -- serving -------------------------------------------------------------
 
     def submit(self, node: int, deadline_s: float | None = None,
-               priority: str = "gold") -> ServeRequest:
+               priority: str = "gold",
+               trace_id: str | None = None) -> ServeRequest:
         """Admit one point query (see :meth:`DeadlineBatcher.submit`);
         the shed policy under a full queue drops bronze before gold, and
-        shed counts land per class on ``serve.shed_requests``."""
+        shed counts land per class on ``serve.shed_requests``.
+        ``trace_id`` joins the request to an existing trace (the fleet's
+        routing/failover propagation seam); absent, a fresh trace opens
+        per request when tracing is on."""
         try:
-            return self.batcher.submit(node, deadline_s, priority)
+            req = self.batcher.submit(node, deadline_s, priority)
         finally:
             self._sync_shed()
+        if self.tracer.enabled:
+            req.trace_id = (trace_id if trace_id is not None
+                            else self.tracer.trace())
+            self.tracer.event(
+                "serve.enqueue", trace=req.trace_id, subsystem="serve",
+                node=int(node), seq=req.seq, priority=priority,
+                replica=self.replica_index,
+            )
+        return req
 
     def warmup(self, buckets=None) -> int:
         """Pre-compile the ladder (all batcher buckets by default);
@@ -326,9 +393,45 @@ class InferenceServer:
             return np.asarray(rows)
         return rows
 
+    def _stage(self, name: str, marks):
+        """One timed batch stage: always lands on the P² timeline; when
+        tracing, also appends a ``(name, t0, dur)`` mark (tracer clock)
+        for span attribution to every request in the batch."""
+        if marks is None:
+            return self.timeline.stage(name)
+        return _MarkedStage(self, name, marks)
+
+    def _emit_batch_spans(self, reqs, bucket, marks, t_batch0, t_pop):
+        """Per-request trace assembly: one ``serve.request`` root from
+        admission to completion, a ``serve.queue_wait`` child from the
+        batcher clock, and the five measured batch stages as children
+        (shared across co-batched requests — they ran fused)."""
+        t_end = self.tracer.now()
+        for r in reqs:
+            qwait = max(t_pop - r.t_admit, 0.0)
+            root = self.tracer.record(
+                "serve.request", t_batch0 - qwait,
+                (t_end - t_batch0) + qwait, trace=r.trace_id,
+                subsystem="serve", node=int(r.node), seq=r.seq,
+                priority=r.priority, bucket=bucket,
+                replica=self.replica_index, missed=bool(r.missed),
+            )
+            self.tracer.record(
+                "serve.queue_wait", t_batch0 - qwait, qwait,
+                trace=r.trace_id, parent=root, subsystem="serve",
+            )
+            for name, t0, dur in marks:
+                self.tracer.record(
+                    f"serve.{name}", t0, dur, trace=r.trace_id,
+                    parent=root, subsystem="serve", bucket=bucket,
+                )
+
     def _run_batch(self, reqs, bucket: int) -> list[ServeRequest]:
+        marks = [] if self.tracer.enabled else None
+        t_batch0 = self.tracer.now() if marks is not None else 0.0
+        t_pop = self.clock()
         capL = self._ladder.lane_caps[-1]
-        with self.timeline.stage("pad"):
+        with self._stage("pad", marks):
             seeds = np.full(bucket, -1, np.int32)
             nvalid = np.zeros(bucket, np.int32)
             seqs = np.zeros(bucket, np.int32)
@@ -340,7 +443,7 @@ class InferenceServer:
             nvalid_d = jnp.asarray(nvalid)
             seqs_d = jnp.asarray(seqs)
         sample_ex = self._ladder.sample_exec(bucket)
-        with self.timeline.stage("sample"):
+        with self._stage("sample", marks):
             n_ids, eis, overflow = sample_ex(
                 self.sampler.topo, seeds_d, nvalid_d, seqs_d, self._base_key
             )
@@ -349,17 +452,17 @@ class InferenceServer:
             # serve-path gather frequencies feed the same sketch the
             # training loop does (padding -1 lanes are filtered there)
             self.controller.observe_serve(np.asarray(n_ids).reshape(-1))
-        with self.timeline.stage("gather"):
+        with self._stage("gather", marks):
             rows = self._host_rows(self.feature[n_ids.reshape(-1)])
             x = jnp.asarray(rows, self._row_dtype).reshape(
                 bucket, capL, self._feature_dim
             )
             jax.block_until_ready(x)
         forward_ex = self._ladder.forward_exec(bucket)
-        with self.timeline.stage("forward"):
+        with self._stage("forward", marks):
             out = forward_ex(x, eis, self.params)
             jax.block_until_ready(out)
-        with self.timeline.stage("readback"):
+        with self._stage("readback", marks):
             out_np = np.asarray(out)
             ovf_np = np.asarray(overflow)
         t_done = self.clock()
@@ -388,6 +491,8 @@ class InferenceServer:
                     SERVE_DEGRADED_LOOKUPS,
                     np.int32(self._serve_degraded_total),
                 )
+        if marks is not None:
+            self._emit_batch_spans(reqs, bucket, marks, t_batch0, t_pop)
         return reqs
 
     # -- parity oracle -------------------------------------------------------

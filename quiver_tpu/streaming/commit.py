@@ -323,12 +323,15 @@ class StreamingGraph:
     """
 
     def __init__(self, csr_topo: CSRTopo, feature=None,
-                 duplicates: str = "error"):
+                 duplicates: str = "error", recorder=None):
         if duplicates not in ("error", "allow"):
             raise ValueError(
                 f"duplicates must be 'error' or 'allow', got {duplicates!r}"
             )
         self.csr_topo = csr_topo
+        # flight-recorder seam: an aborted commit or an admission
+        # quarantine dumps a postmortem bundle naming the stage
+        self.recorder = recorder
         # the admission schema mirrors the committed topology's edge
         # attributes: inserts must carry exactly these (validate_delta
         # rejects mismatches whole, both directions)
@@ -411,6 +414,11 @@ class StreamingGraph:
             "quarantined %d delta batch(es) at %s: %s",
             len(deltas), stage, reason,
         )
+        if self.recorder is not None:
+            self.recorder.trigger(
+                "commit_abort" if stage == "commit" else "quarantine",
+                stage=stage, cause=reason, batches=len(deltas),
+            )
 
     def ingest(self, delta: DeltaBatch) -> bool:
         """Admission-validate ``delta`` and stage it for the next commit.

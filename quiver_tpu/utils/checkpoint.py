@@ -107,12 +107,17 @@ class Checkpointer:
       integrity: verify per-leaf content checksums on restore and
         quarantine failing directories (on by default; ``False`` trusts
         the COMMIT marker alone — the pre-integrity behavior).
+      tracer: optional grafttrace :class:`~quiver_tpu.obs.tracing
+        .Tracer` — each save lands a ``ckpt.save`` span (subsystem
+        ``resilience``) covering the worker-thread write, tagged with
+        the causing trace when the caller passes one.
     """
 
     def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3,
-                 integrity: bool = True):
+                 integrity: bool = True, tracer=None):
         self.directory = os.path.abspath(os.fspath(directory))
         self.integrity = bool(integrity)
+        self.tracer = tracer
         if max_to_keep < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         if self.integrity and max_to_keep < 2:
@@ -232,7 +237,8 @@ class Checkpointer:
     # -- save ----------------------------------------------------------------
 
     def save(self, step: int, state, wait: bool = False,
-             metadata: dict | None = None) -> bool:
+             metadata: dict | None = None,
+             trace: str | None = None) -> bool:
         """Save a state pytree at ``step`` (async by default).
 
         The state is host-materialized and checksummed NOW (the caller
@@ -290,18 +296,22 @@ class Checkpointer:
         )
         self._inflight.add(step)
         self._pending.append(self._pool.submit(
-            self._write_sync, step, b"".join(chunks), treedef_bytes, manifest
+            self._write_sync, step, b"".join(chunks), treedef_bytes,
+            manifest, trace
         ))
         if wait:
             self.wait_until_finished()
         return True
 
     def _write_sync(self, step: int, payload: bytes, treedef_bytes: bytes,
-                    manifest: dict) -> None:
+                    manifest: dict, trace: str | None = None) -> None:
         """Worker-thread body: temp dir -> payload -> COMMIT -> atomic
         rename -> retention. Runs strictly serialized (one worker)."""
         import json
 
+        t0 = self.tracer.now() if (
+            self.tracer is not None and self.tracer.enabled
+        ) else None
         tmp = os.path.join(
             self.directory, f"{_TMP_PREFIX}step-{step}-{os.getpid()}"
         )
@@ -323,6 +333,12 @@ class Checkpointer:
         finally:
             self._inflight.discard(step)
             shutil.rmtree(tmp, ignore_errors=True)
+            if t0 is not None:
+                self.tracer.record(
+                    "ckpt.save", t0, self.tracer.now() - t0, trace=trace,
+                    subsystem="resilience", step=step,
+                    nbytes=len(payload),
+                )
 
     def _sweep_stale_tmp(self, keep: str) -> None:
         """Best-effort removal of temp directories a crashed writer left

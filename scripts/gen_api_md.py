@@ -77,6 +77,12 @@ SECTIONS = [
     ("quiver_tpu.utils.trace", "Tracing/profiling scopes"),
     ("quiver_tpu.obs",
      "graftscope — metrics registry, step timeline, exporters"),
+    ("quiver_tpu.obs.tracing",
+     "grafttrace — causal spans + Chrome trace-event export"),
+    ("quiver_tpu.obs.recorder",
+     "grafttrace — black-box flight recorder, postmortem bundles"),
+    ("quiver_tpu.obs.endpoint",
+     "grafttrace — live telemetry HTTP endpoint"),
     ("quiver_tpu.datasets", "Dataset loaders + planted graphs"),
     ("quiver_tpu.tools.lint",
      "graftlint static analyzer (trace-safety rules)"),

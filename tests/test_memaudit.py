@@ -152,8 +152,10 @@ _SOURCES_EXEMPT = frozenset({
     "quiver_tpu/core/config.py",
     "quiver_tpu/core/memory.py",
     "quiver_tpu/core/sharded_topology.py",
+    "quiver_tpu/obs/endpoint.py",
     "quiver_tpu/obs/export.py",
     "quiver_tpu/obs/timeline.py",
+    "quiver_tpu/obs/tracing.py",
     "quiver_tpu/ops/reindex.py",
     "quiver_tpu/resilience/elastic.py",
     "quiver_tpu/resilience/faults.py",

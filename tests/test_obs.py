@@ -157,6 +157,43 @@ def test_p2_quantile_small_samples_exact():
     assert est.value == 2.0
 
 
+def test_p2_quantile_tiny_n_exact_nearest_rank():
+    """Below the 5-marker warmup the estimator must return the exact
+    nearest-rank order statistic (ceil(q*n), 1-based) — not an
+    interpolated pick that undersells tail quantiles."""
+    # n=1: every quantile IS the single sample
+    for q in (0.01, 0.5, 0.99):
+        est = P2Quantile(q)
+        est.update(7.0)
+        assert est.value == 7.0
+    # n=2: p99 must be the max, p50 the lower sample (ceil(.5*2)=1)
+    hi = P2Quantile(0.99)
+    lo = P2Quantile(0.5)
+    for x in (1.0, 2.0):
+        hi.update(x)
+        lo.update(x)
+    assert hi.value == 2.0
+    assert lo.value == 1.0
+    # n=4: p50 -> 2nd order stat, p95 -> 4th
+    med, tail = P2Quantile(0.5), P2Quantile(0.95)
+    for x in (40.0, 10.0, 30.0, 20.0):
+        med.update(x)
+        tail.update(x)
+    assert med.value == 20.0
+    assert tail.value == 40.0
+
+
+def test_p2_quantile_large_n_accuracy():
+    rng = np.random.default_rng(7)
+    xs = rng.normal(5.0, 2.0, 1000)
+    for q in (0.5, 0.95, 0.99):
+        est = P2Quantile(q)
+        for x in xs:
+            est.update(float(x))
+        exact = float(np.percentile(xs, 100 * q))
+        assert abs(est.value - exact) < 0.25, (q, est.value, exact)
+
+
 def test_timeline_stage_and_report():
     tl = StepTimeline()
     for i in range(20):
@@ -228,14 +265,45 @@ def test_jsonl_file_round_trip(tmp_path):
 def test_prometheus_round_trip():
     snaps = _sample_snapshots()
     text = to_prometheus(snaps)
-    # scrapable exposition shape: TYPE lines + labeled samples
+    # scrapable exposition shape: HELP/TYPE lines + labeled samples
     assert "# TYPE quiver_feature_tier_hits gauge" in text
-    assert 'quiver_feature_tier_hits{idx="3,2"} 11' in text
+    assert "# HELP quiver_feature_tier_hits" in text
+    assert ('quiver_feature_tier_hits'
+            '{name="feature.tier_hits",idx="3,2"} 11') in text
     assert "# TYPE quiver_feature_routed_overflow counter" in text
     back = from_prometheus(text)
     assert len(back) == 3
     for a, b in zip(snaps, back):
         _assert_same(a, b)
+
+
+def test_prometheus_hostile_names_round_trip():
+    """Label-injection hygiene: names containing backslash, quote and
+    newline survive the exposition round trip; distinct dotted names that
+    sanitize to the same exposition name get numeric suffixes instead of
+    silently merging; a hostile name cannot spoof the idx label."""
+    snaps = [
+        MetricSnapshot('evil\\name."quoted"\nline', "counter",
+                       np.int32(3), None, "", 'doc with "quotes"\nand line'),
+        # idx-spoof attempt: name label ends with what looks like idx=
+        MetricSnapshot('spoof",idx="9,9', "gauge",
+                       np.asarray([1.0, 2.0], np.float32), None),
+        # collision pair: both sanitize to quiver_a_b
+        MetricSnapshot("a.b", "counter", np.int32(1), None),
+        MetricSnapshot("a_b", "counter", np.int32(2), None),
+    ]
+    text = to_prometheus(snaps)
+    # every sample line stays one line (no raw newline broke out)
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
+    assert "quiver_a_b_2" in text  # collision got a suffix, not a merge
+    back = from_prometheus(text)
+    assert len(back) == 4
+    for a, b in zip(snaps, back):
+        _assert_same(a, b)
+    # the spoofed gauge kept its true shape — idx wasn't hijacked
+    assert back[1].numpy.shape == (2,)
+    np.testing.assert_array_equal(back[1].numpy, [1.0, 2.0])
 
 
 def test_exporters_agree_on_registry_output():

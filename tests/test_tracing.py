@@ -1,0 +1,528 @@
+"""grafttrace: causal span tracing, the flight recorder, and the live
+telemetry endpoint (quiver_tpu/obs/{tracing,recorder,endpoint}.py).
+
+Fast lane: tracer id/ring/disabled-path semantics and the Chrome
+trace-event export (no jax); flight-recorder ring + atomic bundle
+publish, the kill-mid-dump and torn-bundle drills (no jax); the
+endpoint's three routes over a plain registry; the serving path's
+six-stage request traces + the fleet failover single-trace-id contract;
+the disabled-tracing bitwise differential over a shared AOT cache; and
+the trainer's preempt/resume span stitching + nonfinite-guard postmortem
+bundle on the 8-virtual-device mesh.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quiver_tpu import (
+    CSRTopo,
+    FaultPlan,
+    FlightRecorder,
+    InferenceServer,
+    Preemption,
+    ServingFleet,
+    TelemetryEndpoint,
+    Tracer,
+    TransientFault,
+)
+from quiver_tpu.obs import MetricsRegistry
+from quiver_tpu.obs.recorder import TornBundle, list_bundles, verify_bundle
+from quiver_tpu.obs.registry import GUARD_SKIPPED
+from quiver_tpu.obs.tracing import to_chrome_trace, write_chrome_trace
+from quiver_tpu.resilience.elastic import DegradedFeature
+from test_serving import FakeClock, _graph, _stack
+
+SERVE_STAGES = ("queue_wait", "pad", "sample", "gather", "forward",
+                "readback")
+
+
+# -- tracer core (no jax) ----------------------------------------------------
+
+
+def test_tracer_ids_nesting_and_ring():
+    tr = Tracer(max_spans=4)
+    assert tr.trace() == "t1" and tr.trace() == "t2"
+    # explicit names are deterministic (preempt/resume stitching)
+    assert tr.trace("train.epoch.3") == "train.epoch.3"
+    with tr.span("outer", trace="t1", subsystem="test", k=1) as outer:
+        outer.set("extra", 2)
+        with tr.span("inner", trace="t1", parent=outer):
+            pass
+    inner_s, outer_s = tr.spans()  # inner exits (records) first
+    assert inner_s.name == "inner" and outer_s.name == "outer"
+    assert inner_s.parent_id == outer_s.span_id
+    assert outer_s.parent_id == "" and outer_s.attrs["extra"] == 2
+    assert outer_s.dur >= inner_s.dur >= 0.0
+    assert tr.subsystems() == {"test"}
+    for i in range(10):  # bounded ring: oldest evicted
+        tr.event(f"e{i}", trace="t2")
+    assert len(tr.spans()) == 4
+    assert [s.name for s in tr.spans()] == ["e6", "e7", "e8", "e9"]
+    assert tr.spans_total == 12
+
+
+def test_tracer_span_records_on_raise():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("failing", subsystem="test"):
+            raise ValueError("boom")
+    (s,) = tr.spans()
+    assert s.name == "failing" and s.attrs["error"] == "ValueError"
+
+
+def test_tracer_disabled_is_structurally_noop():
+    tr = Tracer(enabled=False)
+    assert tr.trace() == "" and tr.trace("named") == ""
+    # one shared null scope/span: nothing allocated per call
+    assert tr.span("a") is tr.span("b")
+    with tr.span("a") as s:
+        s.set("k", 1)  # accepted, dropped
+    assert s.attrs == {}
+    assert tr.record("a", 0.0, 1.0) is None
+    assert tr.observe("a", 1.0) is None
+    assert tr.event("a") is None
+    assert tr.spans() == [] and tr.spans_total == 0
+    assert tr.to_chrome() == {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+def test_chrome_trace_export_parses(tmp_path):
+    tr = Tracer()
+    tid = tr.trace()
+    root = tr.record("req", 0.5, 2.0, trace=tid, subsystem="serve",
+                     node=np.int64(7))
+    tr.record("stage", 1.0, 0.25, trace=tid, parent=root,
+              subsystem="serve")
+    path = tmp_path / "trace.json"
+    assert write_chrome_trace(tr.spans(), path) == 2
+    doc = json.loads(path.read_text())  # what Perfetto will parse
+    assert doc["displayTimeUnit"] == "ms"
+    ev_root, ev_child = doc["traceEvents"]
+    for ev in (ev_root, ev_child):
+        assert ev["ph"] == "X" and ev["pid"] == 1 and ev["tid"] >= 1
+        assert ev["args"]["trace_id"] == tid
+    assert ev_root["ts"] == 0.5e6 and ev_root["dur"] == 2.0e6
+    assert ev_root["args"]["node"] == 7  # numpy scalars jsonified
+    assert ev_child["args"]["parent_id"] == ev_root["args"]["span_id"]
+    assert ev_child["cat"] == "serve"
+
+
+# -- flight recorder (no jax) ------------------------------------------------
+
+
+def test_recorder_ring_bundle_and_retention(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("demo.count", doc="a demo counter")
+    reg.set("demo.count", np.int32(5))
+    tr = Tracer()
+    tr.event("decision", subsystem="control")
+    rec = FlightRecorder(tmp_path / "pm", capacity=3, keep=2, tracer=tr)
+    rec.attach_registry(reg)
+    rec.attach_registry(reg)  # idempotent
+    for i in range(5):
+        rec.note("ctrl.repin", row=i)
+    assert [e["seq"] for e in rec.events()] == [3, 4, 5]  # bounded ring
+    path = rec.trigger("breaker_open", stage="gather", fallback="zeros")
+    manifest = verify_bundle(path)
+    assert manifest["reason"] == "breaker_open"
+    assert manifest["stage"] == "gather"
+    assert manifest["attrs"] == {"fallback": "zeros"}
+    assert manifest["spans"] == 1
+    with open(f"{path}/spans.json") as fh:
+        assert len(json.load(fh)["traceEvents"]) == 1
+    with open(f"{path}/metrics.json") as fh:
+        snaps = {s["name"]: s for s in json.load(fh)}
+    assert snaps["demo.count"]["value"] == 5
+    with open(f"{path}/events.json") as fh:
+        assert [e["kind"] for e in json.load(fh)] == ["ctrl.repin"] * 3
+    # retention: only the newest `keep` committed bundles survive
+    rec.dump()
+    rec.dump()
+    kept = rec.bundles()
+    assert len(kept) == 2
+    assert [m["reason"] for _p, m in kept] == ["manual", "manual"]
+    assert rec.bundles_total == 3
+
+
+def test_recorder_survives_kill_mid_dump(tmp_path):
+    """A crash before COMMIT leaves only an invisible temp dir; a torn
+    published dir is quarantined — the previous bundle stays intact
+    either way."""
+    rec = FlightRecorder(tmp_path / "pm", tracer=Tracer())
+    good = rec.trigger("nonfinite_guard", stage="train")
+    with pytest.raises(RuntimeError, match="injected recorder crash"):
+        rec.trigger("crash_drill", stage="train", inject_failure="crash")
+    assert [p for p, _m in rec.bundles()] == [good]
+    torn = rec.trigger("torn_drill", stage="train", inject_failure="torn")
+    with pytest.raises(TornBundle, match="no COMMIT marker"):
+        verify_bundle(torn)
+    assert [p for p, _m in rec.bundles()] == [good]  # quarantined away
+    quarantined = [p.name for p in (tmp_path / "pm").iterdir()
+                   if p.name.startswith("quarantine-")]
+    assert len(quarantined) == 1 and "torn_drill" in quarantined[0]
+    verify_bundle(good)  # previous bundle still byte-perfect
+    # a new recorder over the same directory continues the seq past both
+    rec2 = FlightRecorder(tmp_path / "pm", tracer=Tracer())
+    again = rec2.trigger("manual")
+    assert verify_bundle(again)["seq"] > verify_bundle(good)["seq"]
+
+
+def test_recorder_detects_payload_corruption(tmp_path):
+    rec = FlightRecorder(tmp_path / "pm")
+    path = rec.trigger("manual")
+    epath = f"{path}/events.json"
+    with open(epath, "r+b") as fh:
+        b = fh.read(1)
+        fh.seek(0)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(TornBundle, match="checksum mismatch"):
+        verify_bundle(path)
+    assert list_bundles(rec.directory, quarantine=False) == []
+
+
+# -- telemetry endpoint (no jax) ---------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_endpoint_routes():
+    reg = MetricsRegistry()
+    reg.counter("demo.count", doc="a demo counter")
+    reg.set("demo.count", np.int32(3))
+    tr = Tracer()
+    tr.event("serve.enqueue", trace=tr.trace(), subsystem="serve")
+    with TelemetryEndpoint(metrics=reg, tracer=tr,
+                           health=lambda: {"depth": 0}) as ep:
+        assert ep.running and ep.port > 0
+        code, ctype, body = _get(f"{ep.url}/metrics")
+        assert code == 200 and ctype.startswith("text/plain")
+        assert "quiver_demo_count" in body.decode()
+        code, ctype, body = _get(f"{ep.url}/traces")
+        assert code == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert len(doc["traceEvents"]) == 1
+        code, _ctype, body = _get(f"{ep.url}/healthz")
+        assert code == 200
+        assert json.loads(body) == {"status": "ok", "depth": 0}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{ep.url}/nope")
+        assert ei.value.code == 404
+    assert not ep.running
+    ep.stop()  # idempotent
+
+
+def test_breaker_open_dumps_bundle(tmp_path):
+    """The cold-tier outage fault class: the breaker-open transition
+    triggers a bundle naming the gather stage."""
+    rec = FlightRecorder(tmp_path / "pm")
+    rng = np.random.default_rng(0)
+    rows = rng.normal(size=(16, 4)).astype(np.float32)
+    plan = FaultPlan(feature_faults={0: 5})
+    degraded = DegradedFeature(plan.wrap_feature(_ArrayStore(rows)),
+                               failures=3, probe_every=2,
+                               fallback="zeros", recorder=rec)
+    ids = np.array([1, 2])
+    for _ in range(2):  # closed: failures propagate
+        with pytest.raises(TransientFault):
+            degraded[ids]
+    out = degraded[ids]  # third failure opens: fallback rows, no raise
+    assert degraded.breaker.state == "open"
+    assert np.array_equal(out, np.zeros_like(out))
+    (bundle,) = rec.bundles()
+    assert bundle[1]["reason"] == "breaker_open"
+    assert bundle[1]["stage"] == "gather"
+
+
+class _ArrayStore:
+    """Minimal ids->rows store for the breaker drill."""
+
+    def __init__(self, rows):
+        self.rows = rows
+        self.shape = rows.shape
+        self.dtype = rows.dtype
+
+    def __getitem__(self, ids):
+        return self.rows[np.asarray(ids)]
+
+
+def test_commit_abort_dumps_bundle(tmp_path):
+    """The streaming fault class: an aborted commit triggers a bundle
+    naming the commit stage (and carrying the abort cause)."""
+    from quiver_tpu import CommitAborted, DeltaBatch, StreamingGraph
+
+    rng = np.random.default_rng(5)
+    topo = CSRTopo(
+        edge_index=rng.integers(0, 64, size=(2, 256)).astype(np.int64)
+    )
+    rec = FlightRecorder(tmp_path / "pm")
+    sg = StreamingGraph(topo, recorder=rec)
+    assert sg.ingest(DeltaBatch(
+        edge_inserts=rng.integers(0, 64, size=(2, 8))
+    ))
+    with pytest.raises(CommitAborted):
+        sg.commit(inject_failure="merge")
+    (bundle,) = rec.bundles()
+    assert bundle[1]["reason"] == "commit_abort"
+    assert bundle[1]["stage"] == "commit"
+    assert bundle[1]["attrs"]["cause"]
+    verify_bundle(bundle[0])
+
+
+# -- serving traces ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced(tmp_path_factory):
+    """One warm traced server + recorder over a shared disk AOT cache
+    (the differential test reuses the cache to stay compile-free)."""
+    cache_dir = str(tmp_path_factory.mktemp("aot") / "executables")
+    topo = _graph()
+    _x, feat, sampler, model, params = _stack(topo)
+    clock = FakeClock()
+    tracer = Tracer()
+    rec = FlightRecorder(str(tmp_path_factory.mktemp("pm")), tracer=tracer)
+    server = InferenceServer(sampler, model, params, feat, max_batch=4,
+                             clock=clock, seed=3, aot_cache=cache_dir,
+                             tracer=tracer, recorder=rec)
+    server.warm_from_cache()
+    return {"server": server, "clock": clock, "tracer": tracer,
+            "recorder": rec, "cache_dir": cache_dir,
+            "stack": (sampler, model, params, feat)}
+
+
+def test_serve_six_stage_request_traces(traced):
+    server, tracer = traced["server"], traced["tracer"]
+    tracer.clear()
+    reqs = server.serve([3, 11, 19, 42])
+    by_trace = {}
+    for s in tracer.spans():
+        by_trace.setdefault(s.trace_id, []).append(s)
+    for r in reqs:
+        assert r.trace_id and r.trace_id in by_trace
+        spans = by_trace[r.trace_id]
+        (root,) = [s for s in spans if s.name == "serve.request"]
+        assert root.parent_id == "" and root.attrs["node"] == r.node
+        children = {s.name: s for s in spans if s.parent_id == root.span_id}
+        for stage in SERVE_STAGES:
+            assert f"serve.{stage}" in children, \
+                f"missing serve.{stage} under {r.trace_id}"
+        # the enqueue marker rides the same trace
+        assert any(s.name == "serve.enqueue" for s in spans)
+    assert tracer.subsystems() == {"serve"}
+
+
+def test_serve_trace_endpoint_perfetto(traced):
+    server, tracer = traced["server"], traced["tracer"]
+    tracer.clear()
+    server.serve([5, 9])
+    with TelemetryEndpoint(metrics=server.metrics, tracer=tracer,
+                           health=lambda: {"depth": server.batcher.depth
+                                           }) as ep:
+        _code, _ct, body = _get(f"{ep.url}/traces")
+        doc = json.loads(body)
+        assert {e["name"] for e in doc["traceEvents"]} >= {
+            "serve.request", "serve.sample", "serve.forward"}
+        for ev in doc["traceEvents"]:  # the Perfetto complete-event shape
+            assert ev["ph"] == "X"
+            assert {"name", "cat", "ts", "dur", "pid", "tid",
+                    "args"} <= ev.keys()
+        _code, _ct, body = _get(f"{ep.url}/metrics")
+        assert "quiver_serve_requests" in body.decode()
+        _code, _ct, body = _get(f"{ep.url}/healthz")
+        assert json.loads(body)["status"] == "ok"
+
+
+def test_serve_disabled_tracing_bitwise(traced):
+    """The collect_metrics=False discipline applied to tracing: a traced
+    server and an untraced server answer every (node, seq) bitwise
+    identically (both warm from the shared cache — zero compiles)."""
+    sampler, model, params, feat = traced["stack"]
+
+    def replica(**kw):
+        return InferenceServer(sampler, model, params, feat, max_batch=4,
+                               clock=FakeClock(), seed=3,
+                               aot_cache=traced["cache_dir"], **kw)
+
+    plain = replica()
+    traced_srv = replica(tracer=Tracer())
+    assert plain.warm_from_cache()["compiled"] == 0
+    assert traced_srv.warm_from_cache()["compiled"] == 0
+    nodes = [3, 11, 19, 42, 7]  # full bucket + forced tail
+    out_a = plain.serve(nodes)
+    out_b = traced_srv.serve(nodes)
+    assert plain.tracer.enabled is False and not plain.tracer.spans()
+    assert traced_srv.tracer.spans()  # tracing actually ran on B
+    for ra, rb in zip(out_a, out_b):
+        assert (ra.node, ra.seq) == (rb.node, rb.seq)
+        np.testing.assert_array_equal(
+            np.asarray(ra.result).view(np.uint8),
+            np.asarray(rb.result).view(np.uint8),
+        )
+
+
+def test_fleet_failover_single_trace_id(traced):
+    """A failover request's spans on the rejecting AND the accepting
+    replica share one trace id (admission-only: warm=False, no pump —
+    zero compiles)."""
+    sampler, model, params, feat = traced["stack"]
+    tracer = Tracer()
+    fleet = ServingFleet(sampler, model, params, feat, replicas=2,
+                         aot_cache=None, warm=False, tracer=tracer,
+                         max_batch=2, max_queue=2, clock=FakeClock())
+    # replica 0 full of gold (rejects gold), replica 1 full of bronze
+    # (sheds a bronze to admit gold) — depths tie, so routing tries 0 first
+    for srv, pri in ((fleet.servers[0], "gold"),
+                     (fleet.servers[1], "bronze")):
+        for n in (1, 2):
+            srv.submit(n, priority=pri)
+    req = fleet.submit(7, priority="gold")
+    tid = req.trace_id
+    assert tid
+    spans = [s for s in tracer.spans() if s.trace_id == tid]
+    hops = {s.name: s.attrs["replica"] for s in spans
+            if s.name in ("fleet.route", "fleet.failover")}
+    assert hops == {"fleet.route": 0, "fleet.failover": 1}
+    (enq,) = [s for s in spans if s.name == "serve.enqueue"]
+    assert enq.attrs["subsystem"] == "serve"
+    assert fleet.recompiles == 0
+    assert {s.attrs["subsystem"] for s in spans} == {"fleet", "serve"}
+
+
+# -- trainer traces ----------------------------------------------------------
+
+
+def _traced_trainer(tmp_path, plan=None, guard=False):
+    import optax
+
+    from quiver_tpu import GraphSageSampler
+    from quiver_tpu.feature.shard import ShardedFeature
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    rng = np.random.default_rng(0)
+    n = 96
+    topo = CSRTopo(
+        edge_index=rng.integers(0, n, size=(2, 800)).astype(np.int64)
+    )
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    mesh = make_mesh(data=2, feature=4)
+    store = ShardedFeature(
+        mesh, device_cache_size=n * 8, csr_topo=topo
+    ).from_cpu_tensor(feat)
+    sampler = GraphSageSampler(topo, [3, 2], seed=0, seed_capacity=8)
+    model = GraphSAGE(hidden=8, num_classes=4, num_layers=2)
+    tracer = Tracer()
+    rec = FlightRecorder(tmp_path / "pm", tracer=tracer)
+    trainer = DistributedTrainer(
+        mesh, sampler, store, model, optax.sgd(1e-2), local_batch=8,
+        seed_sharding="all", nonfinite_guard=guard, fault_plan=plan,
+        checkpoint_dir=tmp_path / "ck", checkpoint_every=3,
+        tracer=tracer, recorder=rec,
+    )
+    params, opt = trainer.init(jax.random.PRNGKey(0))
+    labels = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+    return trainer, params, opt, labels, tracer, rec
+
+
+@pytest.mark.slow
+def test_trainer_preempt_resume_stitch_and_guard_bundle(tmp_path):
+    """One epoch under chaos: the NaN-poisoned step trips the guard and
+    dumps a verified bundle naming the train stage; the preemption kills
+    chunk [3, 6); resume re-enters the SAME deterministic epoch trace, so
+    the chunk spans stitch across the restart under one trace id."""
+    plan = FaultPlan(nan_feature_steps=(1,), nan_rows=4, preempt_at_step=4)
+    trainer, p0, o0, labels, tracer, rec = _traced_trainer(
+        tmp_path, plan=plan, guard=True
+    )
+    seed_mat = trainer.pack_epoch(np.tile(np.arange(96), 6), seed=0)
+    assert seed_mat.shape[0] == 9
+    key = jax.random.PRNGKey(7)
+    with pytest.raises(Preemption, match="step 4"):
+        trainer.epoch_scan(p0, o0, seed_mat, labels, key)
+    pr, orr, key_r, step, epoch = trainer.resume(p0, o0)
+    assert step == 3 and epoch == 0
+    trainer.epoch_scan(pr, orr, seed_mat, labels, key_r,
+                       epoch=epoch, start_step=step)
+    spans = tracer.spans()
+    # deterministic epoch trace: both halves carry train.epoch.0
+    chunks = [s for s in spans
+              if s.name == "train.chunk" and s.trace_id == "train.epoch.0"]
+    starts = sorted(s.attrs["start_step"] for s in chunks)
+    assert 0 in starts, "pre-preempt chunk missing from the epoch trace"
+    assert {3, 6} <= set(starts), "resumed chunks did not stitch"
+    (pre,) = [s for s in spans if s.name == "train.preempt"]
+    assert pre.trace_id == "train.epoch.0" and pre.attrs["step"] == 4
+    # checkpoint saves ride the same trace (subsystem resilience)
+    trainer.checkpointer.wait_until_finished()
+    saves = [s for s in tracer.spans() if s.name == "ckpt.save"]
+    assert saves and all(s.trace_id == "train.epoch.0" for s in saves)
+    assert {"trainer", "resilience"} <= tracer.subsystems()
+    # the guard trip dumped an integrity-verified bundle naming train
+    reasons = {m["reason"]: m for _p, m in rec.bundles()}
+    assert "nonfinite_guard" in reasons
+    assert reasons["nonfinite_guard"]["stage"] == "train"
+    assert reasons["nonfinite_guard"]["attrs"]["skipped_total"] >= 1
+    # registry holds the LATEST scan's vector: the resumed run re-enters at
+    # step 3 (past the NaN at step 1), so its 6 steps are all clean
+    resumed = np.asarray(trainer.metrics.value(GUARD_SKIPPED))
+    assert resumed.shape == (6,) and int(resumed.sum()) == 0
+    # the preemption landed in the black-box ring
+    assert any(e["kind"] == "preemption" for e in rec.events())
+    # health + telemetry ride the trainer too
+    health = trainer.health()
+    assert health["workers"] == trainer.workers
+    assert health["guard_trips"] >= 1
+    ep = trainer.serve_telemetry()
+    try:
+        _code, _ct, body = _get(f"{ep.url}/healthz")
+        assert json.loads(body)["status"] == "ok"
+    finally:
+        ep.stop()
+    trainer.checkpointer.close()
+
+
+@pytest.mark.slow
+def test_trainer_disabled_tracing_bitwise(tmp_path):
+    """Tracing off vs on: identical losses bit-for-bit (the tracer rides
+    outside the compiled epoch program)."""
+    import optax
+
+    from quiver_tpu import Feature, GraphSageSampler
+    from quiver_tpu.models.sage import GraphSAGE
+    from quiver_tpu.parallel.mesh import make_mesh
+    from quiver_tpu.parallel.trainer import DistributedTrainer
+
+    rng = np.random.default_rng(1)
+    n = 96
+    topo = CSRTopo(
+        edge_index=rng.integers(0, n, size=(2, 800)).astype(np.int64)
+    )
+    feat = rng.normal(size=(n, 8)).astype(np.float32)
+    labels = jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+    losses = []
+    for tracer in (None, Tracer()):
+        mesh = make_mesh()
+        store = Feature(device_cache_size="1G").from_cpu_tensor(feat)
+        sampler = GraphSageSampler(topo, [3, 2], seed=0, seed_capacity=8)
+        trainer = DistributedTrainer(
+            mesh, sampler, store,
+            GraphSAGE(hidden=8, num_classes=4, num_layers=2),
+            optax.sgd(1e-2), local_batch=8, tracer=tracer,
+        )
+        params, opt = trainer.init(jax.random.PRNGKey(0))
+        seed_mat = trainer.pack_epoch(np.tile(np.arange(96), 6), seed=0)
+        _p, _o, ls = trainer.epoch_scan(params, opt, seed_mat, labels,
+                                        jax.random.PRNGKey(7))
+        losses.append(np.asarray(ls))
+    np.testing.assert_array_equal(
+        losses[0].view(np.uint32), losses[1].view(np.uint32)
+    )
