@@ -410,13 +410,19 @@ def main():
     write_outputs(results, args.out, args.smoke, merge=bool(args.only))
 
 
-def write_outputs(results, out, smoke, merge=False):
+def write_outputs(results, out, smoke, merge=False, trajectory_path=None):
     """Write ``tpu_results.json`` + ``TPU_RESULTS.md`` from job results.
 
     ``merge=True`` folds ``results`` into the existing json (keyed by job)
     instead of replacing it — used by partial re-runs (``--only``) and by
     the single-process chip-window runner (scripts/mega_session.py), which
     writes after EVERY job so a mid-window kill loses nothing.
+
+    ``trajectory_path`` overrides where the consolidated round record is
+    appended (default: the repo-root ledger ``TRAJECTORY``). Tests MUST
+    pass a scratch path (or monkeypatch ``TRAJECTORY``) — the default
+    ledger is the authoritative round-over-round history and must only
+    ever receive real runs.
     """
     os.makedirs(out, exist_ok=True)
     json_path = os.path.join(out, "tpu_results.json")
@@ -528,7 +534,8 @@ def write_outputs(results, out, smoke, merge=False):
     ]
     with open(os.path.join(out, "TPU_RESULTS.md"), "w") as fh:
         fh.write("\n".join(lines))
-    append_trajectory(trajectory_from_results(results, smoke, stamp))
+    append_trajectory(trajectory_from_results(results, smoke, stamp),
+                      path=trajectory_path or TRAJECTORY)
     print("\n".join(lines))
 
 

@@ -122,6 +122,13 @@ def _job(key, value=1.0, error=None, smoke=False, records=None):
 
 
 class TestScoreboardMerge:
+    @pytest.fixture(autouse=True)
+    def _scratch_trajectory(self, tmp_path, monkeypatch):
+        # the default ledger is the repo-root round-over-round history;
+        # no test run may ever append fixture rows to it
+        monkeypatch.setattr(scoreboard, "TRAJECTORY",
+                            str(tmp_path / "BENCH_TRAJECTORY.jsonl"))
+
     def test_failed_rerun_keeps_prior_good_row(self, tmp_path, capsys):
         scoreboard.write_outputs([_job("sampler-hbm", 5.0)], str(tmp_path),
                                  smoke=False)
@@ -133,6 +140,15 @@ class TestScoreboardMerge:
         assert jobs["sampler-hbm"]["retry_error"] == "timeout>1s"
         md = (tmp_path / "TPU_RESULTS.md").read_text()
         assert "kept: newer retry failed" in md
+
+    def test_trajectory_path_param_overrides_ledger(self, tmp_path, capsys):
+        ledger = tmp_path / "elsewhere.jsonl"
+        scoreboard.write_outputs([_job("sampler-hbm", 5.0)], str(tmp_path),
+                                 smoke=False,
+                                 trajectory_path=str(ledger))
+        rows = [json.loads(ln) for ln in ledger.read_text().splitlines()]
+        assert len(rows) == 1 and rows[0]["source"] == "scoreboard"
+        assert not (tmp_path / "BENCH_TRAJECTORY.jsonl").exists()
 
     def test_good_rerun_replaces_prior(self, tmp_path, capsys):
         scoreboard.write_outputs([_job("sampler-hbm", 5.0)], str(tmp_path),
